@@ -1,0 +1,72 @@
+"""dragnet-tpu: a TPU-native framework for analyzing event-stream data.
+
+A ground-up reimplementation of the capability set of
+TritonDataCenter/dragnet (scan / build / query over newline-JSON event
+logs, with krill-style predicates, DTrace-style aggregations, time-pruned
+enumeration, and distributed execution), built JAX-first: columnar record
+batches, vectorized mask/bucketize/segment-sum kernels, and SPMD sharding
+over a device mesh in place of per-record streams and Manta map-reduce
+jobs.
+
+Library facade mirroring the reference's lib/dragnet.js exports:
+query_load, build, index_config, index_scan, index_read,
+datasource_for_config, datasource_for_name.
+"""
+
+from .errors import DNError
+from .query import query_load, metric_serialize, metric_deserialize
+from . import query as mod_query
+from . import jsvalues as jsv
+from . import datasource_file
+
+__version__ = '0.1.0'
+
+
+def datasource_for_name(config, dsname):
+    dsconfig = config.datasource_get(dsname)
+    if dsconfig is None:
+        return DNError('unknown datasource: "%s"' % dsname)
+    return datasource_for_config(dsconfig)
+
+
+def datasource_for_config(dsconfig):
+    bename = dsconfig['ds_backend']
+    if bename in ('cluster', 'manta'):
+        from . import datasource_cluster
+        return datasource_cluster.create_datasource(dsconfig)
+    if bename == 'file':
+        return datasource_file.create_datasource(dsconfig)
+    return DNError('unknown datasource backend: "%s"' % bename)
+
+
+def metrics_for_index(config, dsname, index_config=None):
+    """(reference: lib/dragnet.js:573-598)"""
+    metrics = []
+    if not index_config:
+        for metname, mconfig in config.datasource_list_metrics(dsname):
+            metrics.append(mconfig)
+    else:
+        for mserialized in index_config['metrics']:
+            metrics.append(metric_deserialize(mserialized))
+    return metrics
+
+
+def index_config(config, dsname, mtime_iso):
+    """Generate the index configuration document.
+    (reference: lib/dragnet.js:400-440, lib/dragnet-impl.js:154-169)"""
+    dsconfig = config.datasource_get(dsname)
+    if dsconfig is None:
+        return DNError('unknown datasource: "%s"' % dsname)
+    metrics = metrics_for_index(config, dsname)
+    if len(metrics) == 0:
+        return DNError('no metrics defined for dataset "%s"' % dsname)
+    return {
+        'user': 'nobody',
+        'mtime': mtime_iso,
+        'datasource': {
+            'backend': dsconfig['ds_backend'],
+            'datapath': dsconfig['ds_backend_config'].get('path'),
+        },
+        'metrics': [metric_serialize(m, skip_datasource=True)
+                    for m in metrics],
+    }

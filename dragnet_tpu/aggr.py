@@ -1,0 +1,146 @@
+"""Group-by aggregation with skinner-compatible semantics.
+
+Re-implements the behavior of the reference's `skinner` dependency (Joyent
+node-skinner, #dragnet branch) as used via queryAggrStream
+(reference: lib/dragnet-impl.js:48-89):
+
+* decomposition fields are looked up with jsprim-pluck semantics,
+* bucketized fields must be JS numbers; anything else drops the record,
+* non-bucketized field values are keyed by String(v) — null -> "null",
+  missing -> "undefined", numbers -> their decimal string (this is why
+  `dn scan -b req.caller` shows "null"/"undefined" rows in the goldens),
+* buckets are tracked as ordinal indexes internally (`ordinalBuckets`),
+  but emitted points carry bucket-minimum values so that point streams
+  re-aggregate idempotently (the map/reduce wire-format seam),
+* emission order follows JS object property order: integer-like keys
+  ascending first, then string keys in insertion order.
+
+This host-side implementation is the semantic reference; the vectorized
+device path (ops/aggregate.py) computes identical (key -> weight) maps for
+columnar batches and merges into the same nested structure.
+"""
+
+from . import jsvalues as jsv
+
+
+def _is_array_index(s):
+    if not s or not s.isdigit():
+        return False
+    if len(s) > 1 and s[0] == '0':
+        return False
+    return int(s) < 2 ** 32 - 1
+
+
+def js_key_order(keys):
+    """Order keys the way V8 enumerates own properties: array-index-like
+    keys ascending, then the rest in insertion order."""
+    ints = []
+    rest = []
+    for k in keys:
+        if isinstance(k, int):
+            ints.append(k)
+        elif _is_array_index(k):
+            ints.append(k)
+        else:
+            rest.append(k)
+    ints.sort(key=lambda k: int(k))
+    return ints + rest
+
+
+class Aggregator(object):
+    def __init__(self, query, stage=None):
+        self.decomps = [b['name'] for b in query.qc_breakdowns]
+        self.bucketizers = query.qc_bucketizers
+        self.stage = stage
+        # nested dict: level i keyed by decomp i's key; leaves are weights
+        self.root = {} if self.decomps else 0
+        self.nrecords = 0
+
+    def write(self, fields, value):
+        if self.stage is not None:
+            self.stage.bump('ninputs')
+        keys = []
+        for name in self.decomps:
+            v = jsv.pluck(fields, name)
+            if name in self.bucketizers:
+                if not jsv.is_number(v):
+                    if self.stage is not None:
+                        self.stage.warn(
+                            ValueError('value for field "%s" is not a '
+                                       'number' % name), 'nnonnumeric')
+                    return
+                keys.append(self.bucketizers[name].bucketize(v))
+            else:
+                keys.append(jsv.to_string(v))
+        self._add(keys, value)
+
+    def write_key(self, keys, value):
+        """Add a pre-computed key tuple (ordinals for bucketized fields,
+        strings otherwise) — the entry point for the vectorized path."""
+        self._add(list(keys), value)
+
+    def _add(self, keys, value):
+        self.nrecords += 1
+        if not self.decomps:
+            self.root += value
+            return
+        node = self.root
+        for k in keys[:-1]:
+            nxt = node.get(k)
+            if nxt is None:
+                nxt = {}
+                node[k] = nxt
+            node = nxt
+        last = keys[-1]
+        node[last] = node.get(last, 0) + value
+
+    def _walk(self):
+        """Yield (keys_tuple, weight) in JS property-enumeration order."""
+        if not self.decomps:
+            yield ((), self.root)
+            return
+
+        def rec(node, depth, prefix):
+            if depth == len(self.decomps):
+                yield (tuple(prefix), node)
+                return
+            for k in js_key_order(node.keys()):
+                prefix.append(k)
+                for item in rec(node[k], depth + 1, prefix):
+                    yield item
+                prefix.pop()
+
+        for item in rec(self.root, 0, []):
+            yield item
+
+    def points(self):
+        """Aggregated points: fields carry bucket-min values for bucketized
+        fields (re-ingestable), strings otherwise."""
+        out = []
+        if not self.decomps:
+            out.append(({}, self.root))
+            if self.stage is not None:
+                self.stage.bump('noutputs')
+            return out
+        for keys, weight in self._walk():
+            fields = {}
+            for name, k in zip(self.decomps, keys):
+                if name in self.bucketizers:
+                    fields[name] = self.bucketizers[name].bucket_min(k)
+                else:
+                    fields[name] = k
+            out.append((fields, weight))
+            if self.stage is not None:
+                self.stage.bump('noutputs')
+        return out
+
+    def rows(self):
+        """Flattened result rows in ordinal form: [key..., weight] per row,
+        or a bare total when there are no decompositions (what the
+        reference's SkinnerFlattener emits with resultsAsPoints:false)."""
+        if not self.decomps:
+            return [self.root]
+        rv = []
+        for keys, weight in self._walk():
+            rv.append(list(keys) + [weight])
+        return rv

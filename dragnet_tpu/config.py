@@ -1,0 +1,208 @@
+"""Dragnet configuration: immutable in-memory model + local file backend.
+
+Re-implements lib/config-common.js (clone-on-write DragnetConfig, versioned
+vmaj/vmin 0.0, schema-validated load) and lib/config-local.js (JSON file at
+$DRAGNET_CONFIG or ~/.dragnetrc, atomic tmp+rename save).
+"""
+
+import copy
+import os
+
+from .errors import DNError
+from . import jsvalues as jsv
+from . import query as mod_query
+
+CONFIG_MAJOR = 0
+CONFIG_MINOR = 0
+
+
+class DragnetConfig(object):
+    def __init__(self):
+        # dsname -> {ds_backend, ds_backend_config, ds_filter, ds_format}
+        self.dc_datasources = {}
+        # dsname -> {metname -> Metric}
+        self.dc_metrics = {}
+
+    def clone(self):
+        rv = DragnetConfig()
+        rv.dc_datasources = copy.deepcopy(self.dc_datasources)
+        rv.dc_metrics = {
+            ds: {name: mod_query.metric_deserialize(
+                     mod_query.metric_serialize(m))
+                 for name, m in mets.items()}
+            for ds, mets in self.dc_metrics.items()
+        }
+        return rv
+
+    def datasource_add(self, dsconfig):
+        if dsconfig['name'] in self.dc_datasources:
+            return DNError('datasource "%s" already exists'
+                           % dsconfig['name'])
+        dc = self.clone()
+        dc.dc_datasources[dsconfig['name']] = {
+            'ds_backend': dsconfig['backend'],
+            'ds_backend_config': dict(dsconfig['backend_config']),
+            'ds_filter': dsconfig.get('filter'),
+            'ds_format': dsconfig.get('dataFormat'),
+        }
+        return dc
+
+    def datasource_update(self, dsname, update):
+        if dsname not in self.dc_datasources:
+            return DNError('datasource "%s" does not exist' % dsname)
+        dc = self.clone()
+        config = dc.dc_datasources[dsname]
+        if update.get('backend'):
+            config['ds_backend'] = update['backend']
+        if update.get('filter'):
+            config['ds_filter'] = update['filter']
+        if update.get('dataFormat'):
+            config['ds_format'] = update['dataFormat']
+        bc = update.get('backend_config')
+        if bc:
+            target = config['ds_backend_config']
+            for key in ('path', 'indexPath', 'timeFormat', 'timeField'):
+                if bc.get(key):
+                    target[key] = bc[key]
+        return dc
+
+    def datasource_remove(self, dsname):
+        if dsname not in self.dc_datasources:
+            return DNError('datasource "%s" does not exist' % dsname)
+        dc = self.clone()
+        del dc.dc_datasources[dsname]
+        return dc
+
+    def datasource_get(self, dsname):
+        return self.dc_datasources.get(dsname)
+
+    def datasource_list(self):
+        return list(self.dc_datasources.items())
+
+    def metric_add(self, metconfig):
+        dsname = metconfig['datasource']
+        if dsname in self.dc_metrics and \
+                metconfig['name'] in self.dc_metrics[dsname]:
+            return DNError('metric "%s" already exists' % metconfig['name'])
+        dc = self.clone()
+        dc.dc_metrics.setdefault(dsname, {})
+        dc.dc_metrics[dsname][metconfig['name']] = \
+            mod_query.metric_deserialize(metconfig)
+        return dc
+
+    def metric_remove(self, dsname, metname):
+        if dsname not in self.dc_metrics or \
+                metname not in self.dc_metrics[dsname]:
+            return DNError('datasource "%s" metric "%s" does not exist'
+                           % (dsname, metname))
+        dc = self.clone()
+        del dc.dc_metrics[dsname][metname]
+        return dc
+
+    def metric_get(self, dsname, metname):
+        if dsname not in self.dc_metrics:
+            return None
+        return self.dc_metrics[dsname].get(metname)
+
+    def datasource_list_metrics(self, dsname):
+        assert dsname in self.dc_datasources
+        if dsname not in self.dc_metrics:
+            return []
+        return list(self.dc_metrics[dsname].items())
+
+    def serialize(self):
+        rv = {
+            'vmaj': CONFIG_MAJOR,
+            'vmin': CONFIG_MINOR,
+            'datasources': [],
+            'metrics': [],
+        }
+        for dsname, ds in self.dc_datasources.items():
+            bc = {k: v for k, v in ds['ds_backend_config'].items()
+                  if v is not None}
+            rv['datasources'].append({
+                'name': dsname,
+                'backend': ds['ds_backend'],
+                'backend_config': bc,
+                'filter': ds['ds_filter'],
+                'dataFormat': ds['ds_format'],
+            })
+            for metname, m in self.datasource_list_metrics(dsname):
+                rv['metrics'].append(mod_query.metric_serialize(m))
+        return rv
+
+
+def create_initial_config():
+    return load_config({
+        'vmaj': CONFIG_MAJOR,
+        'vmin': CONFIG_MINOR,
+        'datasources': [],
+        'metrics': [],
+    })
+
+
+def load_config(inp):
+    if not isinstance(inp, dict):
+        return DNError('failed to load config: not an object')
+    vmaj = inp.get('vmaj')
+    if vmaj != CONFIG_MAJOR:
+        return DNError('failed to load config: major version ("%s") '
+                       'not supported' % jsv.to_string(vmaj))
+    for key in ('datasources', 'metrics'):
+        if not isinstance(inp.get(key), list):
+            return DNError('failed to load config: property "%s": '
+                           'required' % key)
+
+    dc = DragnetConfig()
+    for dsconfig in inp['datasources']:
+        dc.dc_datasources[dsconfig['name']] = {
+            'ds_backend': dsconfig['backend'],
+            'ds_backend_config': dsconfig['backend_config'],
+            'ds_filter': dsconfig.get('filter'),
+            'ds_format': dsconfig.get('dataFormat'),
+        }
+    for metconfig in inp['metrics']:
+        dsname = metconfig['datasource']
+        dc.dc_metrics.setdefault(dsname, {})
+        dc.dc_metrics[dsname][metconfig['name']] = \
+            mod_query.metric_deserialize(metconfig)
+    return dc
+
+
+class ConfigBackendLocal(object):
+    """JSON config file with atomic tmp+rename save."""
+
+    def __init__(self, path=None):
+        if path is None:
+            path = os.environ.get('DRAGNET_CONFIG') or \
+                os.path.join(os.environ.get('HOME', '/'), '.dragnetrc')
+        self.cbl_path = path
+
+    def load(self):
+        """Returns (error, config); on error, config is a fresh initial
+        config (matching the reference's loadFinish contract)."""
+        try:
+            with open(self.cbl_path, 'r') as f:
+                data = f.read()
+        except OSError as e:
+            err = DNError(str(e))
+            err.code = getattr(e, 'errno', None)
+            err.is_enoent = isinstance(e, FileNotFoundError)
+            return (err, create_initial_config())
+        try:
+            parsed = jsv.json_parse(data)
+        except ValueError as e:
+            err = DNError(str(e))
+            err.is_enoent = False
+            return (err, create_initial_config())
+        config = load_config(parsed)
+        if isinstance(config, DNError):
+            config.is_enoent = False
+            return (config, create_initial_config())
+        return (None, config)
+
+    def save(self, serialized):
+        tmpname = self.cbl_path + '.tmp'
+        with open(tmpname, 'w') as f:
+            f.write(jsv.json_stringify(serialized))
+        os.rename(tmpname, self.cbl_path)

@@ -1,0 +1,24 @@
+"""Cluster (distributed) datasource backend.
+
+The TPU-native replacement for the reference's Manta map-reduce backend
+(lib/datasource-manta.js): instead of fanning out `dn` invocations as
+compute-job phases, scans and builds shard the input file set across a
+`jax.sharding.Mesh` (SPMD over ICI within a pod, DCN/`jax.distributed`
+across hosts) and merge partial aggregates, which compose because points
+form a commutative monoid (the same property the reference's reduce phase
+relied on).
+
+The backend accepts the reference's `--backend=manta` spelling as an alias
+for config-level compatibility.
+"""
+
+from .errors import DNError
+
+
+def create_datasource(dsconfig):
+    try:
+        from .parallel import cluster  # deferred: jax import is expensive
+    except ImportError:
+        return DNError('cluster datasource backend is unavailable '
+                       '(jax not importable)')
+    return cluster.create_datasource(dsconfig)

@@ -1,0 +1,339 @@
+"""File-backend execution engine: scan, build, query, index-scan,
+index-read.
+
+Re-implements lib/datasource-file.js on the host side: input enumeration
+(strftime-pruned when the datasource has a time format), concatenated line
+parsing, the per-metric scan fan-out for index builds (one pass over raw
+data feeds every metric's aggregator), the hour/day index multiplexer keyed
+on __dn_ts, and the per-index-file query fan-in.
+
+The aggregation hot path is delegated to engine.py (vectorized/JAX) when
+the query shape allows, with scan.py as the exact-semantics fallback.
+"""
+
+import os
+import sys
+
+from .errors import DNError
+from . import jsvalues as jsv
+from . import query as mod_query
+from . import ingest as mod_ingest
+from . import find as mod_find
+from .aggr import Aggregator
+from .scan import StreamScan
+from .vpipe import Pipeline
+from .index_sink import IndexSink
+from .index_query import IndexQuerier
+
+
+def create_datasource(dsconfig):
+    assert dsconfig['ds_backend'] == 'file'
+    if not isinstance(dsconfig['ds_backend_config'].get('path'), str):
+        return DNError('expected datasource "path" to be a string')
+    return DatasourceFile(dsconfig)
+
+
+class ScanResult(object):
+    def __init__(self, pipeline, points=None, dry_run_files=None,
+                 query=None):
+        self.pipeline = pipeline
+        self.points = points
+        self.dry_run_files = dry_run_files
+        self.query = query
+
+
+class DatasourceFile(object):
+    def __init__(self, dsconfig):
+        bc = dsconfig['ds_backend_config']
+        self.ds_format = dsconfig.get('ds_format')
+        self.ds_timeformat = bc.get('timeFormat')
+        self.ds_timefield = bc.get('timeField')
+        self.ds_datapath = bc['path']
+        self.ds_indexpath = bc.get('indexPath')
+        self.ds_filter = dsconfig.get('ds_filter')
+
+    def close(self):
+        pass
+
+    # -- input enumeration ------------------------------------------------
+
+    def _find(self, root, timeformat, start_ms, end_ms, pipeline):
+        """Returns list of (path, stat) or DNError."""
+        if end_ms is None:
+            return mod_find.find_walk([root], pipeline)
+        assert start_ms is not None
+        pathenum = mod_find.create_path_enumerator(
+            os.path.join(root, timeformat), start_ms, end_ms)
+        if isinstance(pathenum, DNError):
+            return pathenum
+        roots = pathenum.paths()
+        return mod_find.find_walk(roots, pipeline, pathenum=pathenum)
+
+    def _scan_init(self, time_after, time_before, pipeline):
+        """Common setup for scan and build: format check, file list.
+        Returns (files, fmt) or DNError.  (Record-level filtering happens
+        downstream in StreamScan / FilterStage.)"""
+        if self.ds_timefield is None and \
+                (time_before is not None or time_after is not None):
+            return DNError('datasource is missing "timefield" for '
+                           '"before" and "after" constraints')
+
+        fmt = mod_ingest.parser_for(self.ds_format)
+        if isinstance(fmt, DNError):
+            return fmt
+
+        if self.ds_timeformat is not None:
+            files = self._find(self.ds_datapath, self.ds_timeformat,
+                               time_after, time_before, pipeline)
+        else:
+            if time_before is not None or time_after is not None:
+                sys.stderr.write('warn: datasource is missing '
+                                 '"timeformat" for "before" and "after" '
+                                 'constraints\n')
+            files = self._find(self.ds_datapath, None, None, None, pipeline)
+        if isinstance(files, DNError):
+            return files
+        return (files, fmt)
+
+    # -- scan -------------------------------------------------------------
+
+    def scan(self, query, dry_run=False, warn_func=None):
+        """Scan raw data to execute a query.  Returns a ScanResult whose
+        points are the aggregated output.  (reference:
+        lib/datasource-file.js:72-108)"""
+        pipeline = Pipeline()
+        pipeline.warn_func = warn_func
+        ctx = self._scan_init(query.qc_after, query.qc_before, pipeline)
+        if isinstance(ctx, DNError):
+            raise ctx
+        files, fmt = ctx
+
+        if dry_run:
+            return ScanResult(pipeline,
+                              dry_run_files=[p for p, st in files])
+
+        stages = mod_ingest.make_parser_stages(pipeline, fmt)
+        scanner = StreamScan(query, self.ds_timefield, pipeline,
+                             ds_filter=self.ds_filter)
+        lines = mod_ingest.iter_lines([p for p, st in files])
+        for fields, value in mod_ingest.iter_records(lines, fmt,
+                                                     stages=stages):
+            scanner.write(fields, value)
+
+        return ScanResult(pipeline, points=scanner.aggr.points(),
+                          query=query)
+
+    # -- build / index-scan -----------------------------------------------
+
+    def check_time_args(self, time_after, time_before):
+        if time_after is not None and time_before is None:
+            return DNError('cannot specify --after without --before')
+        if time_before is not None and time_after is None:
+            return DNError('cannot specify --before without --after')
+        return None
+
+    def check_index_args(self, interval, needsindex, needstime):
+        if needsindex and self.ds_indexpath is None:
+            return DNError('datasource is missing "indexpath"')
+        if needstime and interval != 'all' and self.ds_timefield is None:
+            return DNError('datasource is missing "timefield"')
+        return None
+
+    def build(self, metrics, interval, time_after=None, time_before=None,
+              dry_run=False, warn_func=None):
+        return self._index_scan_impl(
+            metrics, interval, self.ds_filter, time_after, time_before,
+            dry_run, sink='index', warn_func=warn_func)
+
+    def index_scan(self, metrics, interval, filter=None, time_after=None,
+                   time_before=None):
+        return self._index_scan_impl(
+            metrics, interval, filter, time_after, time_before, False,
+            sink='points')
+
+    def _index_scan_impl(self, metrics, interval, filter, time_after,
+                         time_before, dry_run, sink, warn_func=None):
+        """One pass over raw data feeding every metric's scan; output goes
+        to index files (build) or tagged points (index-scan).
+        (reference: lib/datasource-file.js:322-433)"""
+        pipeline = Pipeline()
+        pipeline.warn_func = warn_func
+        error = self.check_time_args(time_after, time_before)
+        if error is None:
+            error = self.check_index_args(interval, sink == 'index', True)
+        if error is not None:
+            raise error
+
+        ctx = self._scan_init(time_after, time_before, pipeline)
+        if isinstance(ctx, DNError):
+            raise ctx
+        files, fmt = ctx
+
+        if dry_run:
+            return ScanResult(pipeline,
+                              dry_run_files=[p for p, st in files])
+
+        queries = [mod_query.metric_query(m, time_after, time_before,
+                                          interval, self.ds_timefield)
+                   for m in metrics]
+
+        stages = mod_ingest.make_parser_stages(pipeline, fmt)
+
+        # The datasource filter is applied once on the shared parse stream;
+        # each metric's own filter lives in its StreamScan (reference:
+        # lib/datasource-file.js:124-192 vs :403-427).
+        ds_filter_stage = None
+        if filter is not None:
+            from . import krill as mod_krill
+            from .scan import FilterStage
+            ds_filter_stage = FilterStage(
+                mod_krill.create(filter),
+                pipeline.stage('Datasource filter'))
+
+        scanners = []
+        for qi, q in enumerate(queries):
+            s = StreamScan(q, self.ds_timefield, pipeline, ds_filter=None)
+            pipeline.stage('Add __dn_metric')
+            scanners.append(s)
+
+        lines = mod_ingest.iter_lines([p for p, st in files])
+        for fields, value in mod_ingest.iter_records(lines, fmt,
+                                                     stages=stages):
+            if ds_filter_stage is not None and \
+                    not ds_filter_stage.accept(fields):
+                continue
+            for s in scanners:
+                s.write(fields, value)
+
+        tagged = []
+        for qi, s in enumerate(scanners):
+            for fields, value in s.aggr.points():
+                fields['__dn_metric'] = qi
+                tagged.append((fields, value))
+
+        if sink == 'points':
+            return ScanResult(pipeline, points=tagged)
+
+        self._index_write(metrics, interval, tagged)
+        return ScanResult(pipeline, points=None)
+
+    def _index_write(self, metrics, interval, tagged_points):
+        """Write aggregated points into interval-chunked index files;
+        sinks are created lazily per time bucket and each file is written
+        atomically.  (reference: lib/datasource-file.js:444-547)"""
+        if interval == 'all':
+            sink = IndexSink(metrics,
+                             os.path.join(self.ds_indexpath, 'all'))
+            for fields, value in tagged_points:
+                sink.write(fields, value)
+            sink.flush()
+            return
+
+        if interval == 'hour':
+            prefixlen = len('2014-07-02T00')
+            suffix = ':00:00Z'
+        elif interval == 'day':
+            prefixlen = len('2014-07-02')
+            suffix = 'T00:00:00Z'
+        else:
+            raise DNError('unsupported interval: "%s"' % interval)
+
+        root = os.path.join(self.ds_indexpath, 'by_' + interval)
+        sinks = {}
+        for fields, value in tagged_points:
+            dnts = fields['__dn_ts']
+            assert jsv.is_number(dnts)
+            datestr = jsv.to_iso_string(dnts * 1000)
+            bucketname = datestr[:prefixlen]
+            if bucketname not in sinks:
+                bucketstart = jsv.date_parse(bucketname + suffix) // 1000
+                label = bucketname.replace('T', '-')
+                indexpath = os.path.join(root, label + '.sqlite')
+                sinks[bucketname] = IndexSink(
+                    metrics, indexpath, config={'dn_start': bucketstart})
+            sinks[bucketname].write(fields, value)
+        for sink in sinks.values():
+            sink.flush()
+
+    def index_read(self, metrics, interval, instream):
+        """Read tagged json-skinner points (from stdin) and write index
+        files.  (reference: lib/datasource-file.js:729-746)"""
+        error = self.check_index_args(interval, True, False)
+        if error is not None:
+            raise error
+        pipeline = Pipeline()
+        points = [(f, v) for f, v in mod_ingest.iter_records(
+            _split_lines(instream), 'json-skinner', pipeline)]
+        self._index_write(metrics, interval, points)
+        return ScanResult(pipeline)
+
+    # -- query ------------------------------------------------------------
+
+    def index_find_params(self, interval, time_after, time_before):
+        """(reference: lib/dragnet-impl.js:194-236)"""
+        if interval == 'day':
+            return (os.path.join(self.ds_indexpath, 'by_day'),
+                    '%Y-%m-%d.sqlite', time_after, time_before)
+        if interval == 'hour':
+            return (os.path.join(self.ds_indexpath, 'by_hour'),
+                    '%Y-%m-%d-%H.sqlite', time_after, time_before)
+        if interval == 'all':
+            return (os.path.join(self.ds_indexpath, 'all'), None, None,
+                    None)
+        return DNError('unsupported interval: "%s"' % interval)
+
+    def query(self, query, interval, dry_run=False):
+        """Query the indexes.  (reference:
+        lib/datasource-file.js:573-691)"""
+        pipeline = Pipeline()
+        error = self.check_time_args(query.qc_after, query.qc_before)
+        if error is None:
+            error = self.check_index_args(interval, True, False)
+        if error is not None:
+            raise error
+
+        params = self.index_find_params(interval or 'all', query.qc_after,
+                                        query.qc_before)
+        if isinstance(params, DNError):
+            raise params
+        root, timeformat, after, before = params
+
+        files = self._find(root, timeformat, after, before, pipeline)
+        if isinstance(files, DNError):
+            raise files
+
+        if dry_run:
+            return ScanResult(pipeline,
+                              dry_run_files=[p for p, st in files])
+
+        index_list = pipeline.stage('Index List')
+        aggr = Aggregator(query,
+                          stage=pipeline.stage('Index Result Aggregator'))
+        for path, st in files:
+            try:
+                qi = IndexQuerier(path)
+            except DNError as e:
+                raise DNError('index "%s"' % path, cause=e)
+            try:
+                sub = Aggregator(query)
+                qi.run(query, aggr=sub)
+            except DNError as e:
+                raise DNError('index "%s" query' % path, cause=e)
+            finally:
+                qi.close()
+            for fields, value in sub.points():
+                index_list.bump('ninputs')
+                index_list.bump('noutputs')
+                aggr.write(fields, value)
+
+        return ScanResult(pipeline, points=aggr.points(), query=query)
+
+
+def _split_lines(instream):
+    data = instream.read()
+    if isinstance(data, str):
+        data = data.encode()
+    lines = data.split(b'\n')
+    if lines and lines[-1] == b'':
+        lines.pop()
+    return lines

@@ -1,0 +1,231 @@
+"""Index reader / query engine: metric selection + pushdown group-by.
+
+Re-implements lib/index-query.js:
+
+* semver-compatibility gate (~2) on the index's embedded version,
+* metric selection (findMetric, lib/index-query.js:154-263): first metric
+  whose filter matches the query's exactly (or has none while the query's
+  field needs are covered), field-superset check, date-field requirement
+  for time-bounded queries,
+* query compilation to `SELECT cols, SUM(value) ... WHERE <filter>
+  GROUP BY cols`, with krill leaves rendered C-style (SQLite accepts both
+  `==` and double-quoted string literals, so semantics carry over exactly),
+* NULL SUM -> 0, and re-aggregation of returned rows through the standard
+  aggregator so per-bucket rows merge into proper points.
+"""
+
+import copy
+import re
+import sqlite3
+
+from .errors import DNError
+from . import jsvalues as jsv
+from . import krill as mod_krill
+from . import query as mod_query
+from .aggr import Aggregator
+from .index_sink import sqlite3_escape
+
+DB_VERSION_MAJOR = 2
+
+
+def _semver_satisfies(version, major):
+    m = re.match(r'^(\d+)\.(\d+)\.(\d+)', version or '')
+    if not m:
+        return False
+    return int(m.group(1)) == major
+
+
+class IndexQuerier(object):
+    def __init__(self, filename):
+        self.qi_dbfilename = filename
+        self.qi_db = sqlite3.connect(
+            'file:%s?mode=ro' % filename.replace('?', '%3f'), uri=True)
+        self.qi_config = None
+        self.qi_metrics = None
+        self._load_config()
+
+    def close(self):
+        self.qi_db.close()
+
+    def _load_config(self):
+        cur = self.qi_db.cursor()
+        try:
+            rows = cur.execute('SELECT * FROM dragnet_config').fetchall()
+        except sqlite3.Error as e:
+            raise DNError(str(e))
+        self.qi_config = {}
+        names = [d[0] for d in cur.description]
+        for r in rows:
+            rd = dict(zip(names, r))
+            self.qi_config[rd['key']] = rd['value']
+
+        if 'version' not in self.qi_config:
+            raise DNError('index missing dragnet "version"')
+        if not _semver_satisfies(self.qi_config['version'],
+                                 DB_VERSION_MAJOR):
+            raise DNError('unsupported index version: "%s"'
+                          % self.qi_config['version'])
+
+        rows = cur.execute('SELECT * FROM dragnet_metrics').fetchall()
+        names = [d[0] for d in cur.description]
+        self.qi_metrics = []
+        for r in rows:
+            rd = dict(zip(names, r))
+            filt = None if rd['filter'] is None else \
+                _json_parse_or_raise(rd['filter'], rd['label'], 'filter')
+            params = [] if rd['params'] is None else \
+                _json_parse_or_raise(rd['params'], rd['label'], 'params')
+            self.qi_metrics.append({
+                'qm_id': rd['id'],
+                'qm_label': rd['label'],
+                'qm_filter': filt,
+                'qm_params': params,
+                'qm_filter_raw': rd['filter'],
+            })
+
+    def find_metric(self, query):
+        """(reference: lib/index-query.js:154-263)"""
+        filter_raw = None
+        if query.qc_filter is not None:
+            filter_raw = jsv.json_stringify(query.qc_filter)
+
+        pred = None
+        for met in self.qi_metrics:
+            datefield = None
+            if met['qm_filter'] is not None:
+                if query.qc_filter is None:
+                    continue
+                if met['qm_filter_raw'] != filter_raw:
+                    continue
+
+            if query.qc_before is not None or query.qc_after is not None:
+                fi = None
+                for i, p in enumerate(met['qm_params']):
+                    if 'date' in p:
+                        fi = i
+                        break
+                if fi is None:
+                    continue
+                datefield = met['qm_params'][fi]['name']
+
+            fields_needed = {}
+            fields_have = {}
+            if query.qc_filter is not None and met['qm_filter'] is None:
+                if pred is None:
+                    pred = mod_krill.create(query.qc_filter)
+                for f in pred.fields():
+                    fields_needed[f] = True
+
+            for b in query.qc_breakdowns:
+                fields_needed[b['name']] = b
+            for b in met['qm_params']:
+                fields_have[b['name']] = b
+
+            okay = all(qf in fields_have for qf in fields_needed)
+            if okay:
+                return {
+                    'datefield': datefield,
+                    'table': 'dragnet_index_%s' % met['qm_id'],
+                    'ignore_filter': met['qm_filter'] is not None,
+                }
+
+        return DNError('no metrics available to serve query')
+
+    def run(self, query, aggr=None):
+        """Execute the query; returns the list of points (or raises
+        DNError).  If `aggr` is given, points are merged into it instead."""
+        table = self.find_metric(query)
+        if isinstance(table, DNError):
+            raise table
+
+        own_aggr = aggr is None
+        if own_aggr:
+            aggr = Aggregator(query)
+
+        whenfilter = mod_query.query_time_bounds_filter(
+            query, table['datefield'])
+        qfilter = None if table['ignore_filter'] else query.qc_filter
+
+        if qfilter is not None and whenfilter is not None:
+            filt = {'and': [copy.deepcopy(qfilter), whenfilter]}
+        elif whenfilter is not None:
+            filt = whenfilter
+        elif qfilter is not None:
+            filt = copy.deepcopy(qfilter)
+        else:
+            filt = {}
+        _escape_filter(filt)
+
+        groupby = [sqlite3_escape(b['name'])
+                   for b in query.qc_breakdowns
+                   if 'date' not in b or b['field'] == b['name']]
+        columns = list(groupby)
+        columns.append('SUM(value) as value')
+
+        sql = 'SELECT ' + ','.join(columns)
+        sql += ' from ' + table['table'] + ' '
+        sql += 'WHERE ' + _to_sql_string(filt) + ' '
+        if groupby:
+            sql += 'GROUP BY ' + ','.join(groupby)
+
+        try:
+            cur = self.qi_db.execute(sql)
+        except sqlite3.Error as e:
+            raise DNError('executing query "%s"' % sql,
+                          cause=DNError(str(e)))
+        names = [d[0] for d in cur.description]
+        points = []
+        for row in cur.fetchall():
+            rd = dict(zip(names, row))
+            fields, value = self._deserialize_row(query, rd)
+            aggr.write(fields, value)
+        if own_aggr:
+            return aggr.points()
+        return None
+
+    def _deserialize_row(self, query, rd):
+        """(reference: lib/index-query.js:382-405; NULL SUM -> 0)"""
+        value = rd.get('value')
+        if value is None:
+            value = 0
+        fields = {}
+        for field in query.qc_breakdowns:
+            col = sqlite3_escape(field['field'])
+            if col in rd:
+                fields[field['name']] = rd[col]
+            # absent column: leave unset (JS undefined semantics)
+        return (fields, value)
+
+
+def _json_parse_or_raise(text, label, what):
+    try:
+        import json
+        return json.loads(text)
+    except ValueError as e:
+        raise DNError('failed to parse %s for metric "%s"' % (what, label),
+                      cause=DNError(str(e)))
+
+
+def _escape_filter(filt):
+    if not filt:
+        return
+    if 'and' in filt:
+        for f in filt['and']:
+            _escape_filter(f)
+        return
+    if 'or' in filt:
+        for f in filt['or']:
+            _escape_filter(f)
+        return
+    key = next(iter(filt))
+    filt[key][0] = sqlite3_escape(filt[key][0])
+
+
+def _to_sql_string(filt):
+    if not filt:
+        return '1'
+    if 'and' in filt:
+        return ' AND '.join('(%s)' % _to_sql_string(c) for c in filt['and'])
+    if 'or' in filt:
+        return ' OR '.join('(%s)' % _to_sql_string(c) for c in filt['or'])
+    return mod_krill.create(filt).to_c_style()

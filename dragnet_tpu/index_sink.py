@@ -1,0 +1,103 @@
+"""Index writer: aggregated points -> self-describing index file.
+
+Schema-compatible with the reference's SQLite index format
+(lib/index-sink.js:116-230): a `dragnet_config` table (version 2.0.0 plus
+extra pairs like dn_start), a `dragnet_metrics` catalog (id, label, filter
+JSON, params JSON), and one `dragnet_index_<i>` table per metric with
+escaped column names ('.'/'-' -> '_'), `integer` columns for aggregated
+fields and varchar(128) otherwise, plus a `value` column.
+
+Durability contract preserved: written to `<name>.<pid>`, fsync disabled
+(pragma synchronous=off), atomically renamed into place on flush
+(lib/index-sink.js:264-304) — a crash never leaves a torn index.
+"""
+
+import os
+import sqlite3
+
+from . import jsvalues as jsv
+from . import query as mod_query
+
+INDEX_VERSION = '2.0.0'
+
+
+def sqlite3_escape(name):
+    return name.replace('.', '_').replace('-', '_')
+
+
+class IndexSink(object):
+    def __init__(self, metrics, filename, config=None):
+        self.is_metrics = metrics
+        self.is_dbfilename = filename
+        self.is_dbtmpfilename = filename + '.' + str(os.getpid())
+        self.is_config = dict(config or {})
+        self.is_nwritten = 0
+
+        dirname = os.path.dirname(self.is_dbtmpfilename)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+
+        self.is_db = sqlite3.connect(self.is_dbtmpfilename)
+        self.is_db.execute('pragma synchronous = off;')
+
+        cur = self.is_db.cursor()
+        cur.execute('CREATE TABLE dragnet_config(\n'
+                    '    key varchar(128) primary key,\n'
+                    '    value varchar(128)\n);')
+        cur.execute('CREATE TABLE dragnet_metrics(\n'
+                    '    id integer,\n'
+                    '    label varchar(64),\n'
+                    '    filter varchar(1024),\n'
+                    '    params varchar(1024)\n);')
+
+        self._insert_sql = []
+        for i, m in enumerate(metrics):
+            tblname = 'dragnet_index_%d' % i
+            cols = []
+            for b in m.m_breakdowns:
+                ctype = 'integer' if 'b_aggr' in b else 'varchar(128)'
+                cols.append('    %s %s' % (sqlite3_escape(b['b_name']),
+                                           ctype))
+            cols.append('    value integer')
+            cur.execute('CREATE TABLE %s(\n%s\n);'
+                        % (tblname, ',\n'.join(cols)))
+            self._insert_sql.append(
+                'INSERT INTO %s VALUES (%s)'
+                % (tblname, ', '.join('?' for _ in cols)))
+
+        configpairs = [('version', INDEX_VERSION)]
+        for k, v in self.is_config.items():
+            assert k != 'version'
+            configpairs.append((k, v))
+        cur.executemany('INSERT INTO dragnet_config VALUES (?, ?)',
+                        configpairs)
+
+        metricrows = []
+        for i, m in enumerate(metrics):
+            ms = mod_query.metric_serialize(m, skip_datasource=True)
+            metricrows.append((
+                i,
+                m.m_name,
+                jsv.json_stringify(m.m_filter),
+                jsv.json_stringify(ms['breakdowns']),
+            ))
+        cur.executemany('INSERT INTO dragnet_metrics VALUES (?, ?, ?, ?)',
+                        metricrows)
+
+    def write(self, fields, value):
+        """Write one aggregated point; fields must carry __dn_metric."""
+        mi = fields['__dn_metric']
+        assert isinstance(mi, int) and 0 <= mi < len(self.is_metrics)
+        m = self.is_metrics[mi]
+        row = []
+        for b in m.m_breakdowns:
+            assert b['b_name'] in fields
+            row.append(fields[b['b_name']])
+        row.append(value)
+        self.is_db.execute(self._insert_sql[mi], row)
+        self.is_nwritten += 1
+
+    def flush(self):
+        self.is_db.commit()
+        self.is_db.close()
+        os.rename(self.is_dbtmpfilename, self.is_dbfilename)

@@ -1,0 +1,86 @@
+"""Ingest: newline-separated JSON (and json-skinner points) -> records.
+
+Re-implements the reference's parse layer (lib/format-json.js):
+
+* the byte stream is the *concatenation* of all found files (catstreams
+  semantics: a partial trailing line joins across file boundaries),
+* each line is JSON-decoded; undecodable lines bump the "json parser"
+  stage's "invalid json" counter and are dropped,
+* format "json": each object becomes a record with weight 1
+  (SkinnerAdapterStream),
+* format "json-skinner": each object is already {"fields":...,"value":N}.
+
+The iterator yields (fields_dict, value) pairs.  A columnar fast path
+(batch.py / ops/) consumes the same line stream in blocks.
+"""
+
+import json
+
+from .errors import DNError
+
+
+def parser_for(fmt):
+    if fmt == 'json-skinner':
+        return 'json-skinner'
+    if fmt == 'json':
+        return 'json'
+    return DNError('unsupported format: "%s"' % fmt)
+
+
+def iter_lines(paths, chunk_size=1 << 20):
+    """Yield decoded text lines from the concatenated contents of paths."""
+    buf = b''
+    for path in paths:
+        with open(path, 'rb') as f:
+            while True:
+                chunk = f.read(chunk_size)
+                if not chunk:
+                    break
+                buf += chunk
+                lines = buf.split(b'\n')
+                buf = lines.pop()
+                for line in lines:
+                    yield line
+    if buf:
+        yield buf
+
+
+def make_parser_stages(pipeline, fmt):
+    """Create the parse-layer pipeline stages eagerly so --counters output
+    preserves the reference's stage order (parser before scan stages)."""
+    parser_stage = pipeline.stage('json parser')
+    adapter_stage = pipeline.stage('SkinnerAdapterStream') \
+        if fmt == 'json' else None
+    return (parser_stage, adapter_stage)
+
+
+def iter_records(lines, fmt, pipeline=None, stages=None):
+    """Yield (fields, value) records with parse counters.
+
+    `fmt` is 'json' or 'json-skinner'.
+    """
+    if stages is not None:
+        parser_stage, adapter_stage = stages
+    elif pipeline is not None:
+        parser_stage, adapter_stage = make_parser_stages(pipeline, fmt)
+    else:
+        parser_stage = adapter_stage = None
+
+    for line in lines:
+        if parser_stage is not None:
+            parser_stage.bump('ninputs')
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            if parser_stage is not None:
+                parser_stage.warn(e, 'invalid json')
+            continue
+        if parser_stage is not None:
+            parser_stage.bump('noutputs')
+        if fmt == 'json':
+            if adapter_stage is not None:
+                adapter_stage.bump('ninputs')
+                adapter_stage.bump('noutputs')
+            yield (obj, 1)
+        else:
+            yield (obj.get('fields', {}), obj.get('value'))

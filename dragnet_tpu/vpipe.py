@@ -1,0 +1,55 @@
+"""Per-stage counters and warnings for the host data pipeline.
+
+The reference wraps every stream with vstream for per-stage counters,
+warnings, and pipeline walking (`dn --counters`, `dn --warnings`;
+reference: bin/dn:902-916, lib/krill-skinner-stream.js:44-48).  Our host
+pipeline is not built from object-mode streams — batches flow through plain
+function stages — but the observability contract is preserved: a Pipeline is
+an ordered list of Stage objects, each with named counters (dumped
+alphabetically, matching vstream's output) and a warning channel.
+
+Counter dump format is byte-compatible with vstream vsDumpCounters:
+    name %-18s, space, counter+':' %-13s, value %8d
+(measured from tests/dn golden output).
+"""
+
+import sys
+
+
+class Stage(object):
+    def __init__(self, name, pipeline=None):
+        self.name = name
+        self.counters = {}
+        self.pipeline = pipeline
+
+    def bump(self, counter, n=1):
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    def warn(self, error, kind):
+        self.bump(kind)
+        if self.pipeline is not None and self.pipeline.warn_func is not None:
+            self.pipeline.warn_func(self, kind, error)
+
+    def dump(self, out):
+        for counter in sorted(self.counters):
+            value = self.counters[counter]
+            if value == 0:
+                continue
+            out.write('%-18s %-13s%8d\n' % (self.name, counter + ':', value))
+
+
+class Pipeline(object):
+    def __init__(self):
+        self.stages = []
+        self.warn_func = None
+
+    def stage(self, name):
+        s = Stage(name, self)
+        self.stages.append(s)
+        return s
+
+    def dump_counters(self, out=None):
+        if out is None:
+            out = sys.stderr
+        for s in self.stages:
+            s.dump(out)

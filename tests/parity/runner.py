@@ -1,0 +1,137 @@
+"""Parity harness: replays the reference's CLI test scenarios against our
+`dn` and compares combined output byte-for-byte with the reference's golden
+files (read from the reference checkout, not copied).
+
+The reference test suite (tools/catest + tests/dn/*) drives `dn` from bash
+and diffs stdout against golden `.out` files; each scenario here mirrors
+one of those scripts' command sequences exactly (including `sort -d`
+post-processing and 2>&1 redirections).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DN = os.path.join(REPO_ROOT, 'bin', 'dn')
+
+REFERENCE = os.environ.get('DN_REFERENCE', '/root/reference')
+DATADIR = os.path.join(REFERENCE, 'tests', 'data')
+
+
+def have_reference():
+    return os.path.isdir(os.path.join(REFERENCE, 'tests', 'dn'))
+
+
+def golden(name):
+    path = os.path.join(REFERENCE, 'tests', 'dn', 'local', name)
+    with open(path) as f:
+        return f.read()
+
+
+class DnRunner(object):
+    """Mimics one reference test script: runs dn commands, accumulating
+    stdout the way the bash scripts do."""
+
+    def __init__(self, tmp_path):
+        self.config_path = str(tmp_path / 'dragnet_test_config.json')
+        self.tmp_path = tmp_path
+        self.out = []
+
+    def env(self):
+        env = dict(os.environ)
+        env['DRAGNET_CONFIG'] = self.config_path
+        return env
+
+    def clear_config(self):
+        if os.path.exists(self.config_path):
+            os.unlink(self.config_path)
+
+    def run(self, args, stdin=None, check=True):
+        """Run dn; returns (stdout, stderr, returncode).
+
+        Runs in-process by default (each `dn` invocation costs ~2s of
+        environment-level interpreter startup otherwise); set
+        DN_PARITY_SUBPROCESS=1 to exercise the real executable.
+        """
+        if os.environ.get('DN_PARITY_SUBPROCESS'):
+            proc = subprocess.run(
+                [sys.executable, DN] + list(args),
+                input=stdin, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, env=self.env())
+            if check and proc.returncode != 0:
+                raise AssertionError(
+                    'dn %r exited %d:\n%s' % (args, proc.returncode,
+                                              proc.stderr.decode()))
+            return (proc.stdout.decode(), proc.stderr.decode(),
+                    proc.returncode)
+
+        import io
+        import contextlib
+        from dragnet_tpu import cli
+
+        old_environ = os.environ.get('DRAGNET_CONFIG')
+        os.environ['DRAGNET_CONFIG'] = self.config_path
+        old_stdin = sys.stdin
+        stdout = io.StringIO()
+        stderr = io.StringIO()
+        try:
+            if stdin is not None:
+                data = stdin.encode() if isinstance(stdin, str) else stdin
+                sys.stdin = io.TextIOWrapper(io.BytesIO(data),
+                                             encoding='utf-8')
+            with contextlib.redirect_stdout(stdout), \
+                    contextlib.redirect_stderr(stderr):
+                rc = cli.main(list(args))
+        finally:
+            sys.stdin = old_stdin
+            if old_environ is None:
+                os.environ.pop('DRAGNET_CONFIG', None)
+            else:
+                os.environ['DRAGNET_CONFIG'] = old_environ
+        if check and rc != 0:
+            raise AssertionError('dn %r exited %d:\n%s'
+                                 % (args, rc, stderr.getvalue()))
+        return (stdout.getvalue(), stderr.getvalue(), rc)
+
+    def dn(self, *args, **kwargs):
+        out, err, rc = self.run(list(args), **kwargs)
+        return out
+
+    def echo(self, line=''):
+        self.out.append(line + '\n')
+
+    def emit(self, text):
+        self.out.append(text)
+
+    def sort_d(self, text):
+        """GNU `sort -d` (dictionary order), as the test scripts use."""
+        proc = subprocess.run(['sort', '-d'], input=text.encode(),
+                              stdout=subprocess.PIPE,
+                              env=dict(os.environ, LC_ALL='C'))
+        return proc.stdout.decode()
+
+    def output(self):
+        return ''.join(self.out)
+
+
+def scan_testcases(scan):
+    """The shared scan test-case fragment
+    (reference: tests/dn/scan_testcases.sh) — asserted identical across
+    raw scans, index queries, and distributed scans."""
+    scan()
+    scan('-b', 'operation')
+    scan('-b', 'operation,req.method,host')
+    scan('-b', 'req.caller')
+    scan('-b', 'operation,req.caller')
+    scan('-f', '{ "eq": [ "req.method", "GET" ] }')
+    scan('-f', '{ "eq": [ "req.method", "GET" ] }', '-b',
+         'operation,req.method,host')
+    scan('-f', '{ "eq": [ "req.caller", "poseidon" ] }')
+    scan('-f', '{ "eq": [ "req.caller", "poseidon" ] }', '-b',
+         'req.caller')
+    scan('-b', 'latency[aggr=quantize]')
+    scan('-b', 'latency[aggr=quantize],operation,host')
+    scan('-b', 'host,operation,latency[aggr=quantize]')
+    scan('-b', 'latency[aggr=lquantize,step=100]')

@@ -1,0 +1,40 @@
+"""Parity: basic scan operations on a single file
+(mirrors reference tests/dn/local/tst.scan_file.sh)."""
+
+import os
+import pytest
+
+from .runner import DnRunner, DATADIR, golden, have_reference, \
+    scan_testcases
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference checkout not available')
+
+ONE_LOG = os.path.join(DATADIR, '2014', '05-01', 'one.log')
+
+
+def test_scan_file(tmp_path):
+    r = DnRunner(tmp_path)
+
+    def scan(*args):
+        r.echo('# dn scan' + (' ' if args else '') + ' '.join(args))
+        r.emit(r.dn('scan', *(list(args) + ['test_file'])))
+        r.echo()
+        r.echo('# dn scan --points' + (' ' if args else '') +
+               ' '.join(args))
+        r.emit(r.sort_d(r.dn('scan', '--points',
+                             *(list(args) + ['test_file']))))
+        r.echo()
+
+    r.clear_config()
+    r.dn('datasource-add', 'test_file', '--path=' + ONE_LOG)
+    scan_testcases(scan)
+    r.clear_config()
+
+    r.dn('datasource-add', 'test_file', '--path=' + ONE_LOG,
+         '--filter', '{ "eq": [ "req.method", "GET" ] }')
+    scan()
+    scan('--filter', '{ "eq": [ "res.statusCode", "200" ] }')
+    r.clear_config()
+
+    assert r.output() == golden('tst.scan_file.sh.out')
