@@ -63,7 +63,17 @@ class Aggregator(object):
         for name in self.decomps:
             v = jsv.pluck(fields, name)
             if name in self.bucketizers:
-                if not jsv.is_number(v):
+                # Bucketizers use JS arithmetic, which coerces numeric
+                # strings (the fixture data plants a latency of "26" to
+                # pin this); anything non-coercible drops the record.
+                if isinstance(v, str):
+                    import math
+                    fv = jsv.to_number(v)
+                    v = None if math.isnan(fv) else \
+                        (int(fv) if fv == int(fv) else fv)
+                elif not jsv.is_number(v):
+                    v = None
+                if v is None:
                     if self.stage is not None:
                         self.stage.warn(
                             ValueError('value for field "%s" is not a '

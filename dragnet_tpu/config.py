@@ -54,7 +54,7 @@ class DragnetConfig(object):
         config = dc.dc_datasources[dsname]
         if update.get('backend'):
             config['ds_backend'] = update['backend']
-        if update.get('filter'):
+        if update.get('filter') is not None:
             config['ds_filter'] = update['filter']
         if update.get('dataFormat'):
             config['ds_format'] = update['dataFormat']

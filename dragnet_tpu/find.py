@@ -134,7 +134,12 @@ class PathEnumerator(object):
             rv.append(self._expand(self.next))
             self.noutputs += 1
             self._increment()
-        self.noutputs += 1  # the final null push is counted too
+        # The reference's Readable (highWaterMark 20) counts the final
+        # null push only when it happens in the same burst as the last
+        # value; with >= 20 paths backpressure defers it to a counterless
+        # _read call (lib/path-enum.js:173-192).
+        if len(rv) < 20:
+            self.noutputs += 1
         return rv
 
 

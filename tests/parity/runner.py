@@ -76,16 +76,39 @@ class DnRunner(object):
         old_stdin = sys.stdin
         stdout = io.StringIO()
         stderr = io.StringIO()
+        saved_fd0 = None
+        writer = None
         try:
             if stdin is not None:
                 data = stdin.encode() if isinstance(stdin, str) else stdin
                 sys.stdin = io.TextIOWrapper(io.BytesIO(data),
                                              encoding='utf-8')
+                # Back /dev/stdin with a real pipe so path-based reads
+                # (datasources on /dev/stdin) see the data too.
+                import threading
+                rfd, wfd = os.pipe()
+                saved_fd0 = os.dup(0)
+                os.dup2(rfd, 0)
+                os.close(rfd)
+
+                def _write():
+                    try:
+                        os.write(wfd, data)
+                    finally:
+                        os.close(wfd)
+
+                writer = threading.Thread(target=_write)
+                writer.start()
             with contextlib.redirect_stdout(stdout), \
                     contextlib.redirect_stderr(stderr):
                 rc = cli.main(list(args))
         finally:
             sys.stdin = old_stdin
+            if saved_fd0 is not None:
+                os.dup2(saved_fd0, 0)
+                os.close(saved_fd0)
+            if writer is not None:
+                writer.join(timeout=10)
             if old_environ is None:
                 os.environ.pop('DRAGNET_CONFIG', None)
             else:
@@ -106,14 +129,42 @@ class DnRunner(object):
         self.out.append(text)
 
     def sort_d(self, text):
-        """GNU `sort -d` (dictionary order), as the test scripts use."""
-        proc = subprocess.run(['sort', '-d'], input=text.encode(),
-                              stdout=subprocess.PIPE,
-                              env=dict(os.environ, LC_ALL='C'))
-        return proc.stdout.decode()
+        """GNU `sort -d` under a glibc UTF-8 locale (what produced the
+        reference goldens): only blanks/alphanumerics significant,
+        case-insensitive primary weight, lowercase-first tiebreak."""
+        def key(line):
+            filtered = [c for c in line if c.isalnum() or c in ' \t']
+            primary = ''.join(filtered).lower()
+            tertiary = ''.join('1' if c.isupper() else '0'
+                               for c in filtered)
+            return (primary, tertiary, line)
+
+        lines = text.splitlines(keepends=True)
+        if lines and not lines[-1].endswith('\n'):
+            lines[-1] += '\n'
+        return ''.join(sorted(lines, key=key))
 
     def output(self):
         return ''.join(self.out)
+
+
+def assert_golden(r, name):
+    """Compare accumulated output to a reference golden; on mismatch dump
+    both sides to /tmp and show a unified diff head."""
+    import difflib
+    actual = r.output()
+    expected = golden(name)
+    if actual == expected:
+        return
+    apath = '/tmp/dn_parity_%s.actual' % name
+    with open(apath, 'w') as f:
+        f.write(actual)
+    diff = list(difflib.unified_diff(
+        expected.splitlines(keepends=True),
+        actual.splitlines(keepends=True),
+        fromfile='golden/' + name, tofile='actual'))
+    raise AssertionError('output differs from %s (actual saved to %s):\n%s'
+                         % (name, apath, ''.join(diff[:80])))
 
 
 def scan_testcases(scan):

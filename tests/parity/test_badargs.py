@@ -1,0 +1,46 @@
+"""Parity: bad-argument handling
+(mirrors reference tests/dn/local/tst.badargs.sh)."""
+
+import os
+import pytest
+
+from .runner import DnRunner, DATADIR, golden, have_reference, assert_golden
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference checkout not available')
+
+ONE_LOG = os.path.join(DATADIR, '2014', '05-01', 'one.log')
+
+
+def test_badargs(tmp_path):
+    r = DnRunner(tmp_path)
+
+    def try_(*args):
+        out, err, rc = r.run(['scan'] + list(args) + ['input'],
+                             check=False)
+        assert rc != 0, 'unexpected success (args: %r)' % (args,)
+        combined = (out + err).splitlines(keepends=True)[:2] \
+            if not out else (out + err)
+        # the script does `dn ... 2>&1 | head -2`
+        lines = (out + err if out else err).splitlines(keepends=True)
+        r.emit(''.join((err + out).splitlines(keepends=True)[:2]))
+        return lines
+
+    r.clear_config()
+    r.dn('datasource-add', '--path=' + ONE_LOG, 'input')
+
+    try_('-b', 'host', '-b', 'req.method,x[=bar]')
+    try_('-b', 'host', '-b', 'req.method,[]')
+    try_('-b', 'host', '-b', 'req.method,foo[')
+    try_('-f', '{')
+    try_('-f', '{ "junk": [ "foo", "bar" ] }')
+    try_('--gnuplot')
+    try_('-b', 'req.method,res.statusCode', '--gnuplot')
+
+    r.dn('datasource-remove', 'input')
+    r.dn('datasource-add', '--path=' + ONE_LOG, '--data-format=junk',
+         'input')
+    try_()
+    r.clear_config()
+
+    assert_golden(r, 'tst.badargs.sh.out')

@@ -1,0 +1,110 @@
+"""Parity: datasource/metric configuration
+(mirrors reference tests/dn/local/tst.config.sh)."""
+
+import pytest
+
+from .runner import DnRunner, golden, have_reference, assert_golden
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference checkout not available')
+
+
+def test_config(tmp_path):
+    r = DnRunner(tmp_path)
+
+    def rundn(*args):
+        r.echo('# dn ' + ' '.join(args))
+        out, err, rc = r.run(list(args), check=True)
+        r.emit(out)
+        r.echo()
+        return rc
+
+    def shouldfail(*args):
+        # `shouldfail rundn ...` pipes rundn's whole output (the "# dn"
+        # echo, dn's merged stdout/stderr, and the trailing blank echo)
+        # through `head -3`.
+        out, err, rc = r.run(list(args), check=False)
+        assert rc != 0
+        block = '# dn ' + ' '.join(args) + '\n' + out + err + '\n'
+        r.emit(''.join(block.splitlines(keepends=True)[:3]))
+        return rc
+
+    r.clear_config()
+
+    rundn('datasource-list')
+    rundn('datasource-list', '-v')
+
+    shouldfail('datasource-add', 'junk3')
+    shouldfail('datasource-add', 'junk3', '--filter={', '--path=/junk')
+
+    rundn('datasource-add', 'junk', '--path=/junk')
+    rundn('datasource-add', 'junk2', '--path=/junk',
+          '--filter={ "eq": [ "req.method", "GET" ] }')
+
+    rundn('datasource-list')
+    rundn('datasource-list', '-v')
+    rundn('datasource-show', 'junk')
+    rundn('datasource-show', '-v', 'junk')
+
+    shouldfail('datasource-add', 'junk', '--path=/junk')
+
+    rundn('datasource-update', 'junk2', '--backend=manta',
+          '--path=/foo/bar', '--index-path=/bar/foo', '--filter={}',
+          '--data-format=json-skinner', '--time-format=%Y',
+          '--time-field=foo')
+    rundn('datasource-show', 'junk2')
+    rundn('datasource-show', '-v', 'junk2')
+    shouldfail('datasource-update')
+    shouldfail('datasource-update', 'nonexistent')
+
+    rundn('datasource-remove', 'junk2')
+    rundn('datasource-list')
+    rundn('datasource-list', '-v')
+
+    rundn('datasource-remove', 'junk')
+    rundn('datasource-list')
+    rundn('datasource-list', '-v')
+
+    shouldfail('datasource-remove', 'junk')
+
+    rundn('datasource-add', 'manta-based', '--backend=manta',
+          '--path=/junk')
+    rundn('datasource-add', 'manta-based2', '--backend=manta',
+          '--path=/junk', '--time-format=%Y/%m/%d/%H',
+          '--data-format=json-skinner')
+    rundn('datasource-list')
+    rundn('datasource-list', '-v')
+
+    rundn('metric-list', 'manta-based')
+    rundn('metric-list', 'manta-based2')
+    rundn('metric-list', '-v', 'manta-based')
+    rundn('metric-list', '-v', 'manta-based2')
+
+    shouldfail('metric-add', '--filter={', 'manta-based', 'met1')
+    shouldfail('metric-add', 'met1')
+
+    rundn('metric-add', 'manta-based', 'met1')
+    rundn('metric-list', 'manta-based')
+    rundn('metric-list', '-v', 'manta-based')
+
+    rundn('metric-add', '--filter={ "eq": [ "req.method", "GET" ] }',
+          'manta-based', 'met2')
+    rundn('metric-add', '--filter={ "eq": [ "req.method", "GET" ] }',
+          '--breakdowns=host,req.method,latency[aggr=quantize]',
+          'manta-based', 'met3')
+    rundn('metric-list', 'manta-based')
+    rundn('metric-list', '-v', 'manta-based')
+
+    shouldfail('metric-add', 'manta-based', 'met1')
+
+    rundn('metric-remove', 'manta-based', 'met1')
+    rundn('metric-remove', 'manta-based', 'met2')
+    rundn('metric-remove', 'manta-based', 'met3')
+    shouldfail('metric-remove', 'manta-based', 'met2')
+
+    rundn('datasource-remove', 'manta-based2')
+    rundn('datasource-remove', 'manta-based')
+    rundn('datasource-list')
+    rundn('datasource-list', '-v')
+
+    assert_golden(r, 'tst.config.sh.out')

@@ -1,0 +1,60 @@
+"""Parity: scans/indexes/queries over empty input (/dev/null), with
+counters (mirrors reference tests/dn/local/tst.empty.sh)."""
+
+import pytest
+
+from .runner import DnRunner, have_reference, assert_golden
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference checkout not available')
+
+
+def test_empty(tmp_path):
+    r = DnRunner(tmp_path)
+    tmpfile = str(tmp_path / 'empty_index')
+
+    def scan(*args):
+        r.echo('# dn scan' + (' ' if args else '') + ' '.join(args))
+        out, err, rc = r.run(['scan'] + list(args) + ['devnull'],
+                             check=False)
+        r.emit(out + err)
+        r.echo()
+        r.echo('# dn scan --points' + (' ' if args else '') +
+               ' '.join(args))
+        out, err, rc = r.run(['scan', '--points'] + list(args) +
+                             ['devnull'], check=False)
+        r.emit(r.sort_d(out + err))
+        r.echo()
+
+    def query(*args):
+        r.echo('# dn query' + (' ' if args else '') + ' '.join(args))
+        out, err, rc = r.run(['query', '--interval=all'] + list(args) +
+                             ['devnull'], check=False)
+        r.emit(out + err)
+
+    r.clear_config()
+    r.dn('datasource-add', 'devnull', '--path=/dev/null',
+         '--index-path=' + tmpfile)
+    scan('--counters')
+    scan('-b', 'timestamp')
+    scan('-b', 'timestamp[aggr=quantize]')
+    scan('-b', 'timestamp[aggr=quantize],req.method')
+    scan('-f', '{ "eq": [ "audit", true ] }', '-b',
+         'timestamp[aggr=quantize],req.method')
+    scan('--counters', '-f', '{ "eq": [ "audit", true ] }')
+
+    r.dn('metric-add', 'devnull', 'total')
+    r.dn('build', '--interval=all', 'devnull')
+    query('--counters')
+
+    r.dn('metric-add', 'devnull', 'met', '-b',
+         'req.method,latency[aggr=quantize]')
+    r.dn('build', '--interval=all', 'devnull')
+    query('--counters')
+    query('-f', '{ "eq": [ "req.method", "GET" ] }')
+    query('-b', 'req.method')
+    query('-b', 'latency')
+    query('--counters', '-b', 'latency')
+    r.clear_config()
+
+    assert_golden(r, 'tst.empty.sh.out')

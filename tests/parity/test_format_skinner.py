@@ -1,0 +1,65 @@
+"""Parity: scan/index/query over json-skinner points as input data — the
+map->reduce wire-format seam tested by composing the CLI with itself
+(mirrors reference tests/dn/local/tst.format_skinner.sh)."""
+
+import os
+import pytest
+
+from .runner import DnRunner, DATADIR, have_reference, assert_golden
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference checkout not available')
+
+ONE_LOG = os.path.join(DATADIR, '2014', '05-01', 'one.log')
+
+
+def test_format_skinner(tmp_path):
+    r = DnRunner(tmp_path)
+    tmpfile = str(tmp_path / 'points.out')
+    tmpfile2 = str(tmp_path / 'index_tree')
+
+    def trace(args, stdin):
+        r.echo('# ' + ' '.join(['dn'] + args))
+        out, err, rc = r.run(args, stdin=stdin)
+        r.emit(out)
+
+    with open(ONE_LOG, 'rb') as f:
+        one_log = f.read()
+
+    r.clear_config()
+    r.dn('datasource-add', 'stdin', '--path=/dev/stdin')
+    r.dn('datasource-add', 'stdin-skinner', '--path=/dev/stdin',
+         '--data-format=json-skinner')
+
+    # Points with no fields
+    pts, _, _ = r.run(['scan', '--points', 'stdin'], stdin=one_log)
+    trace(['scan', 'stdin-skinner'], pts)
+    trace(['scan', 'stdin-skinner'], pts * 2)
+    trace(['scan', 'stdin-skinner'], pts * 3)
+
+    # Points with a couple of fields
+    pts, _, _ = r.run(['scan', '--points', '-b',
+                       'req.method,res.statusCode', 'stdin'],
+                      stdin=one_log)
+    out, _, _ = r.run(['scan', '-b', 'req.method', 'stdin'],
+                      stdin=one_log)
+    r.emit(out)
+    trace(['scan', 'stdin-skinner'], pts * 3)
+    trace(['scan', 'stdin-skinner', '-b', 'req.method'], pts * 3)
+
+    # Indexes
+    r.echo('building index')
+    with open(tmpfile, 'wb') as f:
+        f.write((pts * 3).encode() if isinstance(pts, str) else pts * 3)
+    r.dn('datasource-add', 'test_input', '--path=' + tmpfile,
+         '--data-format=json-skinner', '--index-path=' + tmpfile2)
+    r.dn('metric-add', 'test_input', 'total')
+    r.dn('metric-add', 'test_input', '-b', 'req.method', 'by_method')
+    r.dn('build', '--interval=all', 'test_input')
+    out, _, _ = r.run(['query', '--interval=all', 'test_input'])
+    r.emit(out)
+    out, _, _ = r.run(['query', '--interval=all', 'test_input', '-b',
+                       'req.method'])
+    r.emit(out)
+
+    assert_golden(r, 'tst.format_skinner.sh.out')

@@ -1,0 +1,50 @@
+"""Parity: index build + query on a single file
+(mirrors reference tests/dn/local/tst.index_file.sh)."""
+
+import os
+import pytest
+
+from .runner import DnRunner, DATADIR, have_reference, scan_testcases, \
+    assert_golden
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference checkout not available')
+
+ONE_LOG = os.path.join(DATADIR, '2014', '05-01', 'one.log')
+
+
+def test_index_file(tmp_path):
+    r = DnRunner(tmp_path)
+    tmpfile = str(tmp_path / 'index_tree')
+
+    def scan(*args):
+        r.echo('# dn query' + (' ' if args else '') + ' '.join(args))
+        r.emit(r.dn('query', *(list(args) + ['input'])))
+        r.echo()
+
+    r.clear_config()
+    r.dn('datasource-add', 'input', '--path=' + ONE_LOG,
+         '--index-path=' + tmpfile, '--time-field=time')
+    r.dn('metric-add', 'input', 'big_metric', '-b',
+         'host,operation,req.caller,req.method,latency[aggr=quantize]')
+    r.dn('build', 'input')
+    scan_testcases(scan)
+
+    r.dn('metric-remove', 'input', 'big_metric')
+    r.dn('metric-add', 'input', 'filtered_metric', '-f',
+         '{ "eq": [ "req.method", "GET" ] }')
+    r.dn('build', 'input')
+    scan('-f', '{ "eq": [ "req.method", "GET" ] }')
+    r.clear_config()
+
+    r.dn('datasource-add', 'input', '--path=' + ONE_LOG,
+         '--index-path=' + tmpfile, '--time-field=time',
+         '--filter={ "eq": [ "req.method", "GET" ] }')
+    r.dn('metric-add', 'input', 'bycode', '-b', 'res.statusCode')
+    r.dn('build', 'input')
+    scan()
+    scan('-f', '{ "eq": [ "res.statusCode", 200 ] }')
+
+    r.clear_config()
+
+    assert_golden(r, 'tst.index_file.sh.out')

@@ -1,0 +1,82 @@
+"""Parity: hourly index build + query over the multi-file dataset
+(mirrors reference tests/dn/local/tst.index_fileset.sh)."""
+
+import os
+import pytest
+
+from .runner import DnRunner, DATADIR, have_reference, scan_testcases, \
+    assert_golden
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference checkout not available')
+
+
+def test_index_fileset(tmp_path):
+    r = DnRunner(tmp_path)
+    tmpdir = str(tmp_path / 'index_tree')
+
+    def scan(*args, redir=False):
+        r.echo('# dn query' + (' ' if args else '') + ' '.join(args))
+        out, err, rc = r.run(['query', '--interval=hour'] + list(args) +
+                             ['input'], check=False)
+        r.emit(out + err if redir else out)
+        r.echo()
+
+    r.clear_config()
+    r.dn('datasource-add', 'input', '--path=' + DATADIR,
+         '--index-path=' + tmpdir, '--time-field=time')
+    r.dn('metric-add', 'input', 'myindex', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=86400],host,'
+         'operation', '-b', 'req.caller,req.method,latency[aggr=quantize]')
+    r.dn('build', '--interval=hour', 'input')
+
+    # (cd "$tmpdir" && find . -type f | sort -n)
+    found = []
+    for dirpath, dirnames, filenames in os.walk(tmpdir):
+        for fn in filenames:
+            rel = os.path.relpath(os.path.join(dirpath, fn), tmpdir)
+            found.append('./' + rel)
+    for f in sorted(found):
+        r.echo(f)
+
+    scan_testcases(scan)
+
+    scan('-b', 'timestamp[date,aggr=lquantize,step=3600]', '--gnuplot')
+    scan('-b', 'req.method', '--gnuplot')
+    import shutil
+    shutil.rmtree(tmpdir)
+
+    r.dn('metric-remove', 'input', 'myindex')
+    r.dn('metric-add', 'input',
+         '--filter={ "eq": [ "req.method", "GET" ] }', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=86400]',
+         'myindex')
+    r.dn('build', '--interval=hour', 'input')
+    scan('-f', '{ "eq": [ "req.method", "GET" ] }')
+    shutil.rmtree(tmpdir)
+
+    r.dn('metric-remove', 'input', 'myindex')
+    r.dn('metric-add', 'input', 'myindex', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=60]')
+    r.dn('build', '--interval=hour', 'input')
+
+    scan('--counters', '-b', 'timestamp[aggr=lquantize,step=86400]',
+         redir=True)
+    scan('--counters', '--after', '2014-05-02', '--before', '2014-05-03',
+         redir=True)
+    scan('--counters', '-b', 'timestamp[aggr=lquantize,step=60]',
+         '--after', '2014-05-02T04:05:06.123', '--before',
+         '2014-05-02T04:15:10', redir=True)
+    shutil.rmtree(tmpdir)
+
+    r.clear_config()
+    r.dn('datasource-add', 'input', '--path=/dev/null',
+         '--index-path=' + tmpdir, '--time-field=time')
+    r.dn('metric-add', 'input', '-b', 'timestamp[date,field=time]',
+         'myindex')
+    r.dn('build', 'input')
+    assert not os.path.isdir(tmpdir), 'unexpectedly created index dir'
+
+    r.clear_config()
+
+    assert_golden(r, 'tst.index_fileset.sh.out')

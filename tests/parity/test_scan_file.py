@@ -5,7 +5,7 @@ import os
 import pytest
 
 from .runner import DnRunner, DATADIR, golden, have_reference, \
-    scan_testcases
+    scan_testcases, assert_golden
 
 pytestmark = pytest.mark.skipif(not have_reference(),
                                 reason='reference checkout not available')
@@ -37,4 +37,4 @@ def test_scan_file(tmp_path):
     scan('--filter', '{ "eq": [ "res.statusCode", "200" ] }')
     r.clear_config()
 
-    assert r.output() == golden('tst.scan_file.sh.out')
+    assert_golden(r, 'tst.scan_file.sh.out')

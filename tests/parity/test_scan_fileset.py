@@ -1,0 +1,79 @@
+"""Parity: scans over a multi-file dataset with strftime time-format
+pruning, gnuplot output, dry runs, and counters
+(mirrors reference tests/dn/local/tst.scan_fileset.sh)."""
+
+import pytest
+
+from .runner import DnRunner, DATADIR, REFERENCE, have_reference, \
+    scan_testcases, assert_golden
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference checkout not available')
+
+
+def test_scan_fileset(tmp_path):
+    r = DnRunner(tmp_path)
+    strip = REFERENCE.rstrip('/') + '/'
+
+    def sed_strip(text):
+        # the script pipes through `sed -e s#$__dir/*##`
+        return text.replace(strip, '')
+
+    def scan(*args, redir=False, sed=False):
+        def post(t):
+            return sed_strip(t) if sed else t
+
+        r.echo('# dn scan' + (' ' if args else '') + ' '.join(args))
+        out, err, rc = r.run(['scan'] + list(args) + ['test_input'],
+                             check=False)
+        r.emit(post(out + err) if redir else post(out))
+        r.echo()
+        r.echo('# dn scan --points' + (' ' if args else '') +
+               ' '.join(args))
+        out, err, rc = r.run(['scan', '--points'] + list(args) +
+                             ['test_input'], check=False)
+        if redir:
+            # stderr bypasses the `| sort -d` pipe and flushes first
+            r.emit(post(err))
+            r.emit(post(r.sort_d(out)))
+        else:
+            r.emit(post(r.sort_d(out)))
+        r.echo()
+
+    r.clear_config()
+    r.dn('datasource-add', 'test_input', '--path=' + DATADIR,
+         '--time-format=%Y/%m-%d', '--time-field=time')
+    scan_testcases(scan)
+
+    out, err, rc = r.run(
+        ['scan', '-b', 'timestamp[field=time,date,aggr=lquantize,'
+         'step=86400]', '--gnuplot', 'test_input'])
+    r.emit(out)
+    out, err, rc = r.run(['scan', '-b', 'req.method', '--gnuplot',
+                          'test_input'])
+    r.emit(out)
+
+    scan('--dry-run', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=86400]',
+         redir=True, sed=True)
+    scan('--counters', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=86400]',
+         redir=True)
+
+    scan('--dry-run', '--counters', '--after', '2014-05-02', '--before',
+         '2014-05-03', redir=True, sed=True)
+    scan('--counters', '--after', '2014-05-02', '--before', '2014-05-03',
+         redir=True)
+
+    scan('--dry-run', '--counters', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=60]',
+         '--after', '2014-05-02T04:05:06.123', '--before',
+         '2014-05-02T04:15:10', redir=True, sed=True)
+    scan('--counters', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=60]',
+         '--after', '2014-05-02T04:05:06.123', '--before',
+         '2014-05-02T04:15:10', redir=True)
+
+    r.clear_config()
+
+    assert_golden(r, 'tst.scan_fileset.sh.out')

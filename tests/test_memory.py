@@ -1,0 +1,55 @@
+"""Memory-ceiling regression: scanning 250k records must use memory
+bounded by unique output tuples, not input length (the reference gates
+max RSS at 90 MB for Node via tests/dn/local/tst.scan_250k.sh; our gate
+is growth-based because the interpreter baseline differs per image)."""
+
+import json
+import os
+import resource
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import query as mod_query           # noqa: E402
+from dragnet_tpu.scan import StreamScan              # noqa: E402
+from dragnet_tpu.vpipe import Pipeline               # noqa: E402
+
+
+def _gen_records(n):
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'mktestdata')
+    spec = importlib.util.spec_from_file_location(
+        'mktestdata', path,
+        loader=importlib.machinery.SourceFileLoader('mktestdata', path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mindate_ms = int(mod.MINDATE.timestamp() * 1000)
+    maxdate_ms = int(mod.MAXDATE.timestamp() * 1000)
+    for i in range(n):
+        yield mod.make_record(i, n, mindate_ms, maxdate_ms)
+
+
+@pytest.mark.slow
+def test_scan_250k_memory():
+    n = 250000
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+    q = mod_query.query_load({'breakdowns': []})
+    pipeline = Pipeline()
+    scanner = StreamScan(q, None, pipeline)
+    for rec in _gen_records(n):
+        scanner.write(rec, 1)
+
+    points = scanner.aggr.points()
+    assert points[0][1] == n
+
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    growth_kb = rss_after - rss_before
+    # The count-only aggregate state is O(1); allow generous slack for
+    # allocator noise but fail on O(n) retention (250k records would be
+    # tens of MB if buffered).
+    assert growth_kb < 64 * 1024, 'RSS grew %d KB during scan' % growth_kb
