@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Benchmark harness: records/sec through `dn scan` on muskie-style JSON.
+
+Measures the BASELINE.json config "multi-field group-by over synthetic
+mktestdata records" end-to-end (newline-JSON parse -> filter -> bucketize
+-> group-by), on the default engine (vectorized; jax/TPU kernels engage
+for large batches).
+
+vs_baseline is the speedup over the per-record host pipeline measured in
+the same run — the architectural stand-in for the reference's
+stream-per-record execution model (the reference publishes no numbers of
+its own; see BASELINE.md).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from dragnet_tpu import query as mod_query
+from dragnet_tpu.scan import StreamScan
+from dragnet_tpu.engine import VectorScan, BATCH_SIZE
+from dragnet_tpu.vpipe import Pipeline
+
+QUERY = {
+    'breakdowns': [
+        {'name': 'host'},
+        {'name': 'req.method'},
+        {'name': 'operation'},
+        {'name': 'latency', 'aggr': 'quantize'},
+    ],
+    'filter': {'ne': ['res.statusCode', 599]},
+}
+
+
+def gen_records(n):
+    import importlib.util
+    import importlib.machinery
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'tools', 'mktestdata')
+    loader = importlib.machinery.SourceFileLoader('mktestdata', path)
+    spec = importlib.util.spec_from_file_location('mktestdata', path,
+                                                  loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mindate_ms = int(mod.MINDATE.timestamp() * 1000)
+    maxdate_ms = int(mod.MAXDATE.timestamp() * 1000)
+    lines = []
+    for i in range(n):
+        lines.append(json.dumps(
+            mod.make_record(i, n, mindate_ms, maxdate_ms),
+            separators=(',', ':')))
+    return lines
+
+
+def run_vector(lines, query):
+    pipeline = Pipeline()
+    s = VectorScan(query, None, pipeline)
+    buf = []
+    for line in lines:
+        buf.append(json.loads(line))
+        if len(buf) >= BATCH_SIZE:
+            s.write_batch(buf, [1] * len(buf))
+            buf = []
+    if buf:
+        s.write_batch(buf, [1] * len(buf))
+    return s.aggr
+
+
+def run_host(lines, query):
+    pipeline = Pipeline()
+    s = StreamScan(query, None, pipeline)
+    for line in lines:
+        s.write(json.loads(line), 1)
+    return s.aggr
+
+
+def main():
+    nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
+    host_sample = min(nrecords, 50000)
+
+    t0 = time.time()
+    lines = gen_records(nrecords)
+    gen_s = time.time() - t0
+
+    def q():
+        return mod_query.query_load(QUERY)
+
+    # warm up (jit compilation happens here, outside the timed region,
+    # as it would be cached in a long-running service)
+    run_vector(lines[:BATCH_SIZE], q())
+
+    t0 = time.time()
+    aggr = run_vector(lines, q())
+    vec_s = time.time() - t0
+    npoints = len(aggr.points())
+
+    t0 = time.time()
+    run_host(lines[:host_sample], q())
+    host_s = time.time() - t0
+
+    vec_rps = nrecords / vec_s
+    host_rps = host_sample / host_s
+
+    sys.stderr.write(
+        'bench: %d records, %d output points; gen %.1fs; '
+        'vector %.2fs (%.0f rec/s); host-sample %.2fs (%.0f rec/s); '
+        'engine=%s\n'
+        % (nrecords, npoints, gen_s, vec_s, vec_rps, host_s, host_rps,
+           os.environ.get('DN_ENGINE', 'auto')))
+
+    print(json.dumps({
+        'metric': 'scan_records_per_sec',
+        'value': round(vec_rps),
+        'unit': 'records/s',
+        'vs_baseline': round(vec_rps / host_rps, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -55,6 +55,10 @@ class DatasourceFile(object):
     def close(self):
         pass
 
+    def _vector_scan_cls(self):
+        from .engine import VectorScan
+        return VectorScan
+
     # -- input enumeration ------------------------------------------------
 
     def _find(self, root, timeformat, start_ms, end_ms, pipeline):
@@ -113,12 +117,32 @@ class DatasourceFile(object):
                               dry_run_files=[p for p, st in files])
 
         stages = mod_ingest.make_parser_stages(pipeline, fmt)
-        scanner = StreamScan(query, self.ds_timefield, pipeline,
-                             ds_filter=self.ds_filter)
-        lines = mod_ingest.iter_lines([p for p, st in files])
-        for fields, value in mod_ingest.iter_records(lines, fmt,
-                                                     stages=stages):
-            scanner.write(fields, value)
+        records = mod_ingest.iter_records(
+            mod_ingest.iter_lines([p for p, st in files]), fmt,
+            stages=stages)
+
+        # The vectorized engine produces identical results; --warnings
+        # needs the per-record host path for ordered warning output.
+        from .engine import engine_mode
+        use_vector = warn_func is None and engine_mode() != 'host'
+        if use_vector:
+            from .engine import BATCH_SIZE
+            scanner = self._vector_scan_cls()(
+                query, self.ds_timefield, pipeline,
+                ds_filter=self.ds_filter)
+            buf_r, buf_w = [], []
+            for fields, value in records:
+                buf_r.append(fields)
+                buf_w.append(value)
+                if len(buf_r) >= BATCH_SIZE:
+                    scanner.write_batch(buf_r, buf_w)
+                    buf_r, buf_w = [], []
+            scanner.write_batch(buf_r, buf_w)
+        else:
+            scanner = StreamScan(query, self.ds_timefield, pipeline,
+                                 ds_filter=self.ds_filter)
+            for fields, value in records:
+                scanner.write(fields, value)
 
         return ScanResult(pipeline, points=scanner.aggr.points(),
                           query=query)

@@ -1,0 +1,353 @@
+"""Vectorized scan engine: columnar batches -> masks -> bucketize ->
+fused-key aggregation.
+
+This is the TPU-native execution path for the scan operator (the host
+path in scan.py is the semantic reference; differential tests assert
+identical results).  Per batch:
+
+1. evaluate datasource/user filters as ternary outcome vectors
+   (TRUE/FALSE/ERROR) via per-unique-value leaf tables,
+2. parse synthetic date fields (vectorized, with undef/baddate drops),
+3. apply the time-bounds filter,
+4. bucketize aggregated columns and dictionary-encode key columns,
+5. fuse per-column codes into a mixed-radix composite key and
+   segment-sum the weights into a dense accumulator,
+6. merge the (sparse) nonzero buckets into the running Aggregator.
+
+Step 5 runs either on numpy (bincount; no compile overhead, right for
+CLI-sized inputs) or as a jitted jax kernel (segment-sum -> scatter-add
+on TPU; selected automatically for large batches or via DN_ENGINE=jax).
+Partial accumulators merge by addition, so the same kernel shards over a
+device mesh with a psum merge (see parallel/).
+"""
+
+import os
+
+import numpy as np
+
+from . import jsvalues as jsv
+from . import batch as mod_batch
+from . import query as mod_query
+from .aggr import Aggregator
+from .ops.kernels import FALSE, TRUE, ERROR
+
+BATCH_SIZE = 65536
+JAX_THRESHOLD = 32768
+MAX_DENSE_SEGMENTS = 1 << 24
+
+
+def engine_mode():
+    return os.environ.get('DN_ENGINE', 'auto')
+
+
+class LeafTable(object):
+    """Evaluates one predicate leaf per unique value of its column."""
+
+    def __init__(self, field, op, const, rawcol):
+        self.field = field
+        self.op = op
+        self.const = const
+        self.rawcol = rawcol
+        self.table = np.zeros(0, dtype=np.int8)
+
+    def _outcome(self, v):
+        if v is jsv.UNDEFINED:
+            return ERROR
+        if self.op == 'eq':
+            return TRUE if jsv.loose_eq(v, self.const) else FALSE
+        if self.op == 'ne':
+            return FALSE if jsv.loose_eq(v, self.const) else TRUE
+        return TRUE if jsv.relational(v, self.const, self.op) else FALSE
+
+    def outcomes(self, codes):
+        values = self.rawcol.dict.values
+        if len(self.table) < len(values):
+            new = [self._outcome(v)
+                   for v in values[len(self.table):]]
+            self.table = np.concatenate(
+                [self.table, np.array(new, dtype=np.int8)])
+        return self.table[codes]
+
+
+class VectorPredicate(object):
+    """Compiles a krill AST into a ternary outcome vector over a batch."""
+
+    def __init__(self, pred_ast, raw_columns):
+        self.ast = pred_ast
+        self.leaves = {}
+        self.raw_columns = raw_columns
+        self.fields = []
+        self._collect(pred_ast)
+
+    def _collect(self, ast):
+        if not ast:
+            return
+        op = next(iter(ast))
+        if op in ('and', 'or'):
+            for sub in ast[op]:
+                self._collect(sub)
+            return
+        field, const = ast[op]
+        key = (field, op, jsv.json_stringify(const))
+        if key not in self.leaves:
+            if field not in self.raw_columns:
+                self.raw_columns[field] = mod_batch.RawColumn()
+            self.leaves[key] = LeafTable(field, op, const,
+                                         self.raw_columns[field])
+        if field not in self.fields:
+            self.fields.append(field)
+
+    def outcomes(self, code_arrays, n):
+        return self._eval(self.ast, code_arrays, n)
+
+    def _eval(self, ast, code_arrays, n):
+        if not ast:
+            return np.full(n, TRUE, dtype=np.int8)
+        op = next(iter(ast))
+        if op in ('and', 'or'):
+            outs = [self._eval(sub, code_arrays, n) for sub in ast[op]]
+            state = outs[0].copy()
+            if op == 'and':
+                for o in outs[1:]:
+                    m = state == TRUE
+                    state[m] = o[m]
+            else:
+                for o in outs[1:]:
+                    m = state == FALSE
+                    state[m] = o[m]
+            return state
+        field, const = ast[op]
+        key = (field, op, jsv.json_stringify(const))
+        return self.leaves[key].outcomes(code_arrays[field])
+
+
+class VectorScan(object):
+    """Batch-at-a-time scan with results identical to scan.StreamScan."""
+
+    def __init__(self, query, time_field, pipeline, ds_filter=None):
+        self.query = query
+        self.raw_columns = {}
+        self.string_columns = {}
+        self.stages = []
+
+        self.ds_pred = self.user_pred = None
+        if ds_filter is not None:
+            self.ds_pred = VectorPredicate(ds_filter, self.raw_columns)
+            self.ds_stage = pipeline.stage('Datasource filter')
+        if query.qc_filter is not None:
+            self.user_pred = VectorPredicate(query.qc_filter,
+                                             self.raw_columns)
+            self.user_stage = pipeline.stage('User filter')
+
+        self.synthetic = list(query.qc_synthetic)
+        self.time_bounds = None
+        if query.qc_before is not None or query.qc_after is not None:
+            assert isinstance(time_field, str)
+            self.synthetic.append({'name': 'dn_ts', 'field': time_field,
+                                   'date': ''})
+            self.time_bounds = (mod_query._ceil_div(query.qc_after, 1000),
+                                mod_query._ceil_div(query.qc_before,
+                                                    1000))
+        self.synth_stage = pipeline.stage('Datetime parser') \
+            if self.synthetic else None
+        self.time_stage = pipeline.stage('Time filter') \
+            if self.time_bounds else None
+
+        self.aggr = Aggregator(query, stage=pipeline.stage('Aggregator'))
+        for b in query.qc_breakdowns:
+            if b['name'] not in query.qc_bucketizers:
+                self.string_columns[b['name']] = mod_batch.StringColumn()
+
+        self._jax_agg = None
+
+    # -- per-batch execution ---------------------------------------------
+
+    def write_batch(self, records, weights):
+        n = len(records)
+        if n == 0:
+            return
+        alive = np.ones(n, dtype=bool)
+        weights = np.asarray(weights, dtype=np.float64)
+
+        # filter columns: encode raw values once per field
+        code_arrays = {}
+        for field, rawcol in self.raw_columns.items():
+            code_arrays[field] = rawcol.encode(
+                mod_batch.pluck_column(records, field))
+
+        for pred, stage in ((self.ds_pred,
+                             getattr(self, 'ds_stage', None)),
+                            (self.user_pred,
+                             getattr(self, 'user_stage', None))):
+            if pred is None:
+                continue
+            stage.bump('ninputs', int(alive.sum()))
+            out = pred.outcomes(code_arrays, n)
+            failed = alive & (out == ERROR)
+            dropped = alive & (out == FALSE)
+            nfail = int(failed.sum())
+            ndrop = int(dropped.sum())
+            if nfail:
+                stage.bump('nfailedeval', nfail)
+            if ndrop:
+                stage.bump('nfilteredout', ndrop)
+            alive &= (out == TRUE)
+            stage.bump('noutputs', int(alive.sum()))
+
+        # synthetic date fields
+        synth_values = {}
+        if self.synthetic:
+            self.synth_stage.bump('ninputs', int(alive.sum()))
+            first_err = np.zeros(n, dtype=np.uint8)
+            for fieldconf in self.synthetic:
+                vals, err = mod_batch.date_column(
+                    mod_batch.pluck_column(records, fieldconf['field']))
+                synth_values[fieldconf['name']] = vals
+                first_err = np.where(first_err == 0, err, first_err)
+            nundef = int((alive & (first_err == mod_batch.UNDEF)).sum())
+            nbad = int((alive & (first_err == mod_batch.BADDATE)).sum())
+            if nundef:
+                self.synth_stage.bump('undef', nundef)
+            if nbad:
+                self.synth_stage.bump('baddate', nbad)
+            alive &= (first_err == 0)
+            self.synth_stage.bump('noutputs', int(alive.sum()))
+
+        if self.time_bounds is not None:
+            self.time_stage.bump('ninputs', int(alive.sum()))
+            ts = synth_values['dn_ts']
+            ok = (ts >= self.time_bounds[0]) & (ts < self.time_bounds[1])
+            ndrop = int((alive & ~ok).sum())
+            if ndrop:
+                self.time_stage.bump('nfilteredout', ndrop)
+            alive &= ok
+            self.time_stage.bump('noutputs', int(alive.sum()))
+
+        self.aggr.stage.bump('ninputs', int(alive.sum()))
+
+        # key columns
+        key_codes = []
+        decoders = []
+        for b in self.query.qc_breakdowns:
+            name = b['name']
+            if name in self.query.qc_bucketizers:
+                if name in synth_values:
+                    vals = synth_values[name]
+                    valid = np.ones(n, dtype=bool)
+                else:
+                    vals, valid = mod_batch.numeric_column(
+                        mod_batch.pluck_column(records, name))
+                nbadnum = int((alive & ~valid).sum())
+                if nbadnum:
+                    self.aggr.stage.bump('nnonnumeric', nbadnum)
+                alive = alive & valid
+                ords = self._bucketize(b, vals)
+                uniq, codes = np.unique(ords, return_inverse=True)
+                key_codes.append(codes.astype(np.int64))
+                decoders.append([int(u) for u in uniq])
+            else:
+                if name in synth_values:
+                    col = self.string_columns[name]
+                    vals = synth_values[name]
+                    codes = col.encode([
+                        int(v) if float(v).is_integer() else float(v)
+                        for v in vals])
+                else:
+                    col = self.string_columns[name]
+                    codes = col.encode(
+                        mod_batch.pluck_column(records, name))
+                key_codes.append(codes)
+                decoders.append(col.dict.values)
+
+        if not key_codes:
+            total = float(np.sum(np.where(alive, weights, 0.0)))
+            self.aggr.write_key((), self._weight(total))
+            return
+
+        radices = [len(d) for d in decoders]
+        num_segments = 1
+        for r in radices:
+            num_segments *= max(r, 1)
+        if num_segments > MAX_DENSE_SEGMENTS or 0 in radices:
+            self._sparse_merge(key_codes, decoders, weights, alive)
+            return
+
+        dense = self._dense_aggregate(key_codes, radices, weights, alive,
+                                      n)
+
+        # Which keys occurred (including zero-weight ones — the host
+        # reference emits those too), and in what order: inserting each
+        # distinct tuple at its first-occurrence position makes the
+        # nested-dict walk reproduce the host path's emission order
+        # exactly.
+        fused_host = np.zeros(n, dtype=np.int64)
+        for codes, r in zip(key_codes, radices):
+            fused_host = fused_host * r + codes
+        uniq, first_idx = np.unique(fused_host[alive], return_index=True)
+        order = np.argsort(first_idx, kind='stable')
+        for fused in uniq[order].tolist():
+            w = dense[fused]
+            key = []
+            f = fused
+            for r, dec in zip(reversed(radices), reversed(decoders)):
+                f, c = divmod(f, r)
+                key.append(dec[c])
+            key.reverse()
+            self.aggr.write_key(tuple(key), self._weight(w))
+
+    def _weight(self, w):
+        return int(w) if float(w).is_integer() else w
+
+    def _bucketize(self, b, vals):
+        bz = self.query.qc_bucketizers[b['name']]
+        if isinstance(bz, mod_query.P2Bucketizer):
+            exp = np.frexp(vals)[1]
+            return np.where(vals < 1, 0, exp).astype(np.int64)
+        return np.floor(vals / bz.step).astype(np.int64)
+
+    def _dense_aggregate(self, key_codes, radices, weights, alive, n):
+        # 'auto' favors the numpy bincount for single-device CLI runs
+        # (dispatch latency dwarfs these kernel sizes, especially over a
+        # tunneled accelerator); DN_ENGINE=jax forces the device kernel,
+        # and the mesh/cluster path always runs on devices.
+        mode = engine_mode()
+        use_jax = False
+        if mode == 'jax':
+            from .ops import get_jax
+            use_jax = get_jax() is not None
+
+        num_segments = 1
+        for r in radices:
+            num_segments *= r
+
+        if use_jax:
+            # The i32 device kernel is exact only when the batch's total
+            # integer weight fits; float or oversized weights use the f64
+            # host path (the reference contract is exact sums).
+            int_w = bool(np.all(weights == np.floor(weights)))
+            if int_w and float(np.abs(weights).sum()) < 2 ** 31:
+                from .ops.kernels import make_aggregate
+                agg = make_aggregate(tuple(radices), n, True)
+                codes = np.stack(key_codes).astype(np.int32)
+                w = weights.astype(np.int32)
+                return np.asarray(agg(codes, w, alive)).astype(np.float64)
+
+        fused = np.zeros(n, dtype=np.int64)
+        for codes, r in zip(key_codes, radices):
+            fused = fused * r + codes
+        w = np.where(alive, weights, 0.0)
+        return np.bincount(fused, weights=w, minlength=num_segments)
+
+    def _sparse_merge(self, key_codes, decoders, weights, alive):
+        """Cardinality overflow: merge per-record (bounded-memory hash
+        aggregation instead of a dense accumulator)."""
+        idx = np.nonzero(alive)[0]
+        for i in idx.tolist():
+            key = tuple(dec[int(codes[i])]
+                        for codes, dec in zip(key_codes, decoders))
+            self.aggr.write_key(key, self._weight(float(weights[i])))
+
+    # -- compatibility with StreamScan host interface --------------------
+
+    def finish(self):
+        return self.aggr

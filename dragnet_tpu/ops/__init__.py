@@ -1,0 +1,35 @@
+"""Vectorized kernels for the scan hot path.
+
+The reference's per-record hot loop (JSON.parse -> predicate.eval ->
+Date.parse -> hash update, one JS callback round-trip per record per stage;
+see SURVEY.md §3.1) becomes, per columnar batch:
+
+* predicate -> 3-state mask fold (ops/predicate.py),
+* bucketize -> elementwise power-of-two / linear kernels (ops/bucketize.py),
+* group-by  -> mixed-radix key fusion + segment-sum (ops/aggregate.py).
+
+Kernels are written against jax.numpy and jit-compiled (MXU/VPU on TPU;
+XLA:CPU in tests), with semantics pinned to the host reference
+implementation in aggr.py/scan.py by differential tests.
+
+jax is imported lazily and 64-bit mode is enabled on first use: epoch
+seconds and latencies exceed float32's exact-integer range, so bucket
+arithmetic must run in f64/i64.
+"""
+
+_jax = None
+
+
+def get_jax():
+    """Import jax on demand with x64 enabled; returns (jax, jnp) or None
+    if jax is unavailable."""
+    global _jax
+    if _jax is None:
+        try:
+            import jax
+            jax.config.update('jax_enable_x64', True)
+            import jax.numpy as jnp
+            _jax = (jax, jnp)
+        except Exception:
+            _jax = False
+    return _jax if _jax else None

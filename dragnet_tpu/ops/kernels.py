@@ -1,0 +1,90 @@
+"""jit-compiled scan kernels: fused group-by segment-sum (+ mask fold,
+bucketize helpers).
+
+Device kernels are 32-bit native: TPU has no native 64-bit integer path
+(XLA's x64 rewrite rejects the s64 bitcasts that e.g. jnp.frexp emits),
+and every quantity here fits 32 bits by construction — dictionary codes
+and bucket ordinals are dense small ints, epoch seconds < 2^31, and
+integer weights are exact in i32 (float weights use f32).  Exact p2/linear
+bucketization happens host-side in the engine (numpy frexp on f64); the
+device-side p2_bucketize here (log2 + boundary fix-up, TPU-compilable)
+exists for fully-on-device pipelines.
+
+Semantics contract (pinned by differential tests against aggr.py):
+
+* p2: v < 1 -> 0; v >= 1 -> floor(log2 v) + 1   (DTrace quantize)
+* linear: floor(v / step)
+* predicate outcomes are ternary (FALSE/TRUE/ERROR) folding with JS
+  short-circuit rules: `and` -> first non-true, `or` -> first non-false
+* fuse + segment-sum: mixed-radix composite key into a dense
+  accumulator; partials merge by addition (psum across a mesh)
+"""
+
+import functools
+
+from . import get_jax
+
+FALSE, TRUE, ERROR = 0, 1, 2
+
+
+def p2_bucketize(jnp, v):
+    """f32 values -> i32 p2 bucket ordinals, exact at bucket boundaries.
+
+    Uses log2 with a +-1 fix-up instead of frexp: frexp's exponent
+    extraction lowers to a 64-bit bitcast that TPU's x64 rewrite cannot
+    compile, while log2/exp2 on f32 are native.
+    """
+    e = jnp.floor(jnp.log2(jnp.maximum(v, 1.0))).astype('int32')
+    pow_e = jnp.exp2(e.astype('float32'))
+    e = jnp.where(pow_e > v, e - 1, e)
+    e = jnp.where(pow_e * 2.0 <= v, e + 1, e)
+    return jnp.where(v < 1, 0, e + 1).astype('int32')
+
+
+def linear_bucketize(jnp, v, step):
+    return jnp.floor(v / step).astype('int32')
+
+
+def fold_and(jnp, outcomes):
+    """outcomes: list of i8 arrays; first non-TRUE operand wins."""
+    state = outcomes[0]
+    for o in outcomes[1:]:
+        state = jnp.where(state == TRUE, o, state)
+    return state
+
+
+def fold_or(jnp, outcomes):
+    """first non-FALSE operand wins."""
+    state = outcomes[0]
+    for o in outcomes[1:]:
+        state = jnp.where(state == FALSE, o, state)
+    return state
+
+
+@functools.lru_cache(maxsize=None)
+def make_aggregate(radices, capacity, integer_weights=True):
+    """Jitted (codes[ncols,cap] i32, weights[cap], alive[cap] bool) ->
+    dense accumulator of size prod(radices).
+
+    XLA lowers the segment-sum to a scatter-add.  Cached per shape so
+    growing dictionaries only recompile when a radix grows.
+    """
+    jax, jnp = get_jax()
+    num_segments = 1
+    for r in radices:
+        num_segments *= int(r)
+    wdtype = 'int32' if integer_weights else 'float32'
+
+    @jax.jit
+    def agg(codes, weights, alive):
+        fused = jnp.zeros((capacity,), dtype='int32')
+        for i, r in enumerate(radices):
+            fused = fused * jnp.int32(r) + codes[i]
+        fused = jnp.where(alive, fused, num_segments)  # dead -> overflow
+        w = jnp.where(alive, weights.astype(wdtype),
+                      jnp.zeros((), dtype=wdtype))
+        dense = jax.ops.segment_sum(w, fused,
+                                    num_segments=num_segments + 1)
+        return dense[:num_segments]
+
+    return agg

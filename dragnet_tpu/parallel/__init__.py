@@ -1,0 +1,16 @@
+"""Distributed execution: SPMD over a jax.sharding.Mesh.
+
+Replaces the reference's Manta map-reduce job orchestration
+(lib/datasource-manta.js: job templates, tarball asset distribution, 1s
+polling, argv re-serialization) with the TPU-native model:
+
+* the same program runs everywhere (SPMD) — no code distribution step,
+* the scan's map phase is the sharded batch kernel (records axis sharded
+  over mesh devices), and the reduce phase is a psum/reduce_scatter over
+  ICI instead of a json-skinner object hand-off,
+* multi-host runs initialize jax.distributed (DCN control plane) and
+  partition the input file list by process index — the analog of Manta
+  assigning one map task per object,
+* the serialized query plan (a plain dataclass/JSON) replaces
+  queryToCliArgs argv re-serialization as the cross-process contract.
+"""

@@ -1,0 +1,105 @@
+"""Cluster datasource: the distributed execution backend.
+
+Same public surface as the file backend (scan/build/query/index-scan/
+index-read), but execution is SPMD over the device mesh:
+
+* the record axis of every batch shards across local devices, with the
+  dense accumulator merged by psum over ICI (mesh.sharded_aggregate),
+* under a multi-host launch (DN_COORDINATOR et al., see distributed.py),
+  each process scans its slice of the found files — the map-phase
+  partitioning — and the psum over the global mesh is the reduce phase,
+* index builds write per-process partial artifacts that merge by
+  addition (the same commutative-monoid property the reference's Manta
+  reduce relied on).
+
+Config-level the backend accepts `--backend=cluster` (and `manta` as a
+compatibility alias).
+"""
+
+import numpy as np
+
+from ..errors import DNError
+from ..engine import VectorScan
+from .. import datasource_file
+from . import mesh as mod_mesh
+from . import distributed as mod_dist
+
+
+class MeshVectorScan(VectorScan):
+    """VectorScan whose dense aggregation runs sharded over the mesh."""
+
+    def _dense_aggregate(self, key_codes, radices, weights, alive, n):
+        from ..ops import get_jax
+        if get_jax() is None:
+            return super(MeshVectorScan, self)._dense_aggregate(
+                key_codes, radices, weights, alive, n)
+        codes = np.stack(key_codes)
+        return mod_mesh.sharded_aggregate(codes, radices, weights, alive)
+
+
+class DatasourceCluster(datasource_file.DatasourceFile):
+    """File-layout datasource executed over the device mesh / process
+    set."""
+
+    def _find(self, root, timeformat, start_ms, end_ms, pipeline):
+        files = super(DatasourceCluster, self)._find(
+            root, timeformat, start_ms, end_ms, pipeline)
+        if isinstance(files, DNError):
+            return files
+        nprocs, pid = mod_dist.maybe_initialize()
+        if nprocs > 1:
+            files = mod_dist.partition_files(files, nprocs, pid)
+        return files
+
+    def _vector_scan_cls(self):
+        return MeshVectorScan
+
+    def scan(self, query, dry_run=False, warn_func=None):
+        """Local scan over this process's file partition, then a
+        points-level cross-process merge (process_allgather of the
+        partial aggregates — the reduce phase).  Merging serialized
+        points rather than dense accumulators means per-process string
+        dictionaries never need to agree, and it works for every engine
+        path (vector, host, --warnings)."""
+        result = super(DatasourceCluster, self).scan(
+            query, dry_run=dry_run, warn_func=warn_func)
+        nprocs, pid = mod_dist.maybe_initialize()
+        if dry_run or nprocs <= 1 or result.points is None:
+            return result
+        result.points = _allgather_merge_points(query, result.points)
+        return result
+
+
+def _allgather_merge_points(query, points):
+    """Exchange each process's partial aggregate (as serialized points —
+    the same commutative-monoid wire format the reference's map->reduce
+    used) and re-aggregate.  Every process computes the full result."""
+    from ..ops import get_jax
+    from .. import jsvalues as jsv
+    from ..aggr import Aggregator
+    import json
+    jax, _ = get_jax()
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps([[f, v] for f, v in points]).encode()
+    data = np.frombuffer(payload, dtype=np.uint8)
+    # pad to a common length across processes
+    lens = multihost_utils.process_allgather(
+        np.array([data.shape[0]], dtype=np.int64))
+    maxlen = int(np.max(lens))
+    padded = np.zeros(maxlen, dtype=np.uint8)
+    padded[:data.shape[0]] = data
+    gathered = multihost_utils.process_allgather(padded)
+
+    aggr = Aggregator(query)
+    for i in range(gathered.shape[0]):
+        raw = bytes(gathered[i][:int(lens[i][0])])
+        for fields, value in json.loads(raw.decode()):
+            aggr.write(fields, value)
+    return aggr.points()
+
+
+def create_datasource(dsconfig):
+    if not isinstance(dsconfig['ds_backend_config'].get('path'), str):
+        return DNError('expected datasource "path" to be a string')
+    return DatasourceCluster(dsconfig)

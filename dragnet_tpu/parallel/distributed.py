@@ -1,0 +1,57 @@
+"""Multi-host execution: jax.distributed control plane + input
+partitioning.
+
+The reference distributes work by submitting Manta jobs (one map task per
+object, code shipped as a tarball asset, 1-second job polling:
+lib/datasource-manta.js:461-638).  Here every host runs the same program:
+
+* DN_COORDINATOR / DN_NUM_PROCESSES / DN_PROCESS_ID (or the standard JAX
+  cluster env) select the jax.distributed coordinator over DCN,
+* each process scans files[process_id::num_processes] — the map-phase
+  partitioning, pruned by the same strftime/time-bounds logic as local
+  scans,
+* the dense partial accumulators merge with psum over the global mesh
+  (ICI within a pod, DCN across), replacing the reduce-phase object
+  hand-off; every process computes the full result, process 0 prints.
+"""
+
+import os
+
+from ..ops import get_jax
+
+_initialized = False
+
+
+def maybe_initialize():
+    """Initialize jax.distributed when multi-host env vars are present.
+    Returns (num_processes, process_id)."""
+    global _initialized
+    j = get_jax()
+    if j is None:
+        return (1, 0)
+    jax, _ = j
+
+    coord = os.environ.get('DN_COORDINATOR')
+    if coord and not _initialized:
+        nprocs = int(os.environ['DN_NUM_PROCESSES'])
+        pid = int(os.environ['DN_PROCESS_ID'])
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs,
+                                   process_id=pid)
+        _initialized = True
+
+    try:
+        return (jax.process_count(), jax.process_index())
+    except Exception:
+        return (1, 0)
+
+
+def partition_files(files, num_processes, process_id):
+    """Deterministic map-phase partitioning of the found file list."""
+    return [f for i, f in enumerate(files)
+            if i % num_processes == process_id]
+
+
+def is_output_process():
+    _, pid = maybe_initialize()
+    return pid == 0

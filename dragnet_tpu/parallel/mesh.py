@@ -1,0 +1,112 @@
+"""Mesh-sharded aggregation kernels.
+
+The scan reduce is a commutative-monoid merge (sum of weights per key
+tuple), so distribution is: shard the record axis across mesh devices,
+segment-sum locally, then all-reduce (psum) the dense accumulators over
+ICI.  For large accumulators a reduce_scatter variant shards the segment
+axis instead, leaving each device with a disjoint slice of the result —
+the time-sharded index-build layout (each device owns whole time buckets,
+no cross-device traffic until the final artifact merge).
+"""
+
+import functools
+
+import numpy as np
+
+from ..ops import get_jax
+
+
+def local_devices():
+    j = get_jax()
+    if j is None:
+        return []
+    jax, _ = j
+    return jax.devices()
+
+
+def make_mesh(devices=None, axis='d'):
+    """Mesh over the process-local devices: each process aggregates its
+    own input partition on its own chips; cross-process merge happens at
+    the points level (see cluster.py), so dictionary code spaces never
+    need to align between hosts."""
+    jax, _ = get_jax()
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.local_devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_aggregate_cached(radices, per_device, ndev, scatter,
+                              integer_weights):
+    jax, jnp = get_jax()
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = make_mesh()
+    assert len(mesh.devices.flat) == ndev
+
+    num_segments = 1
+    for r in radices:
+        num_segments *= int(r)
+    wdtype = 'int32' if integer_weights else 'float32'
+
+    def local_step(codes, weights, alive):
+        # codes: [ncols, per_device] i32; weights/alive: [per_device]
+        fused = jnp.zeros((per_device,), dtype='int32')
+        for i, r in enumerate(radices):
+            fused = fused * jnp.int32(r) + codes[i]
+        fused = jnp.where(alive, fused, num_segments)
+        w = jnp.where(alive, weights.astype(wdtype),
+                      jnp.zeros((), dtype=wdtype))
+        dense = jax.ops.segment_sum(w, fused,
+                                    num_segments=num_segments + 1)
+        return dense[:num_segments]
+
+    if scatter:
+        def step(codes, weights, alive):
+            dense = local_step(codes, weights, alive)
+            # each device keeps a disjoint 1/ndev slice of the buckets
+            return jax.lax.psum_scatter(dense, 'd', tiled=True)
+        out_spec = P('d')
+    else:
+        def step(codes, weights, alive):
+            dense = local_step(codes, weights, alive)
+            return jax.lax.psum(dense, 'd')
+        out_spec = P()
+
+    sharded = shard_map(step, mesh=mesh,
+                        in_specs=(P(None, 'd'), P('d'), P('d')),
+                        out_specs=out_spec)
+    return jax.jit(sharded), mesh
+
+
+def sharded_aggregate(key_codes, radices, weights, alive, scatter=False):
+    """Aggregate across all local mesh devices.
+
+    key_codes: [ncols, n] int64 (host); weights: [n] f64; alive: [n] bool.
+    Pads the record axis to a multiple of the device count (padding rows
+    are dead) and returns the dense accumulator as numpy.
+    """
+    jax, jnp = get_jax()
+    ndev = len(jax.local_devices())
+    n = weights.shape[0]
+    num_segments = 1
+    for r in radices:
+        num_segments *= int(r)
+    if scatter and num_segments % ndev != 0:
+        scatter = False
+
+    pad = (-n) % ndev
+    if pad:
+        key_codes = np.pad(key_codes, ((0, 0), (0, pad)))
+        weights = np.pad(weights, (0, pad))
+        alive = np.pad(alive, (0, pad))
+
+    per_device = (n + pad) // ndev
+    int_w = bool(np.all(weights == np.floor(weights)))
+    fn, mesh = _sharded_aggregate_cached(tuple(int(r) for r in radices),
+                                         per_device, ndev, scatter, int_w)
+    out = fn(key_codes.astype(np.int32),
+             weights.astype(np.int32 if int_w else np.float32), alive)
+    return np.asarray(out).astype(np.float64)

@@ -1,13 +1,23 @@
 import os
 import sys
 
-# Multi-device tests run on a virtual CPU mesh; must be set before jax
-# import anywhere in the test process.
+# Multi-device tests run on a virtual 8-device CPU mesh.  The environment
+# may have imported jax before this conftest runs (sitecustomize), so
+# setting env vars alone is not enough — also force the config keys if
+# jax is already imported but its backend is not yet initialized.
 os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (
         xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+if 'jax' in sys.modules:
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+        jax.config.update('jax_num_cpu_devices', 8)
+    except Exception:
+        pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
