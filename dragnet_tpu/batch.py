@@ -50,10 +50,14 @@ def js_value_key(v):
     if isinstance(v, bool):
         return ('b', v)
     if jsv.is_number(v):
-        return ('n', float(v))
+        return ('n', jsv.as_float(v))
     if isinstance(v, str):
         return ('s', v)
-    return ('o',)  # objects/arrays: treated uniformly (rare in filters)
+    if isinstance(v, list):
+        # arrays compare via ToPrimitive (join), so their string form is
+        # exactly their comparison-equivalence class
+        return ('a', jsv.to_string(v))
+    return ('o',)  # plain objects all coerce to "[object Object]"
 
 
 class StringColumn(object):
@@ -102,7 +106,7 @@ def numeric_column(values):
             valid[i] = False
             out[i] = 0.0
         elif isinstance(v, (int, float)):
-            out[i] = v
+            out[i] = jsv.as_float(v)
         elif isinstance(v, str):
             f = jsv.to_number(v)
             if f != f:
@@ -130,7 +134,7 @@ def date_column(values):
         if v is jsv.UNDEFINED:
             err[i] = UNDEF
         elif jsv.is_number(v) and not isinstance(v, bool):
-            out[i] = v
+            out[i] = jsv.as_float(v)
         else:
             key = v if isinstance(v, str) else None
             ms = cache.get(key, -1)

@@ -14,6 +14,8 @@ the query shape allows, with scan.py as the exact-semantics fallback.
 import os
 import sys
 
+import numpy as np
+
 from .errors import DNError
 from . import jsvalues as jsv
 from . import query as mod_query
@@ -116,20 +118,29 @@ class DatasourceFile(object):
             return ScanResult(pipeline,
                               dry_run_files=[p for p, st in files])
 
-        stages = mod_ingest.make_parser_stages(pipeline, fmt)
-        records = mod_ingest.iter_records(
-            mod_ingest.iter_lines([p for p, st in files]), fmt,
-            stages=stages)
-
         # The vectorized engine produces identical results; --warnings
         # needs the per-record host path for ordered warning output.
+        # Within the vectorized path, ingest prefers the native C++
+        # parser (projection + dictionary encoding in one pass) and
+        # falls back to the Python record path.
         from .engine import engine_mode
         use_vector = warn_func is None and engine_mode() != 'host'
+        native_lib = None
         if use_vector:
+            from . import native as mod_native
+            native_lib = mod_native.get_lib()
+
+        if use_vector and native_lib is not None:
+            scanner = self._scan_native(query, files, fmt, pipeline)
+        elif use_vector:
             from .engine import BATCH_SIZE
+            stages = mod_ingest.make_parser_stages(pipeline, fmt)
             scanner = self._vector_scan_cls()(
                 query, self.ds_timefield, pipeline,
                 ds_filter=self.ds_filter)
+            records = mod_ingest.iter_records(
+                mod_ingest.iter_lines([p for p, st in files]), fmt,
+                stages=stages)
             buf_r, buf_w = [], []
             for fields, value in records:
                 buf_r.append(fields)
@@ -139,13 +150,107 @@ class DatasourceFile(object):
                     buf_r, buf_w = [], []
             scanner.write_batch(buf_r, buf_w)
         else:
+            stages = mod_ingest.make_parser_stages(pipeline, fmt)
             scanner = StreamScan(query, self.ds_timefield, pipeline,
                                  ds_filter=self.ds_filter)
+            records = mod_ingest.iter_records(
+                mod_ingest.iter_lines([p for p, st in files]), fmt,
+                stages=stages)
             for fields, value in records:
                 scanner.write(fields, value)
 
         return ScanResult(pipeline, points=scanner.aggr.points(),
                           query=query)
+
+    def _scan_native(self, query, files, fmt, pipeline):
+        """Scan via the C++ columnar parser: one pass over the
+        concatenated bytes, projected fields only, batched into the
+        vectorized engine.  (The byte stream is the concatenation of all
+        files — a partial trailing line joins across file boundaries,
+        matching catstreams semantics.)"""
+        from . import native as mod_native
+        from .engine import BATCH_SIZE
+
+        stages = mod_ingest.make_parser_stages(pipeline, fmt)
+        parser_stage, adapter_stage = stages
+        scanner = self._vector_scan_cls()(
+            query, self.ds_timefield, pipeline, ds_filter=self.ds_filter)
+
+        skinner = fmt == 'json-skinner'
+        proj = scanner.projection()
+        if skinner:
+            paths = ['fields.' + p for p, h in proj] + ['value']
+            hints = [h for p, h in proj] + [False]
+        else:
+            paths = [p for p, h in proj]
+            hints = [h for p, h in proj]
+        parser = mod_native.NativeParser(paths, hints)
+        remap = {p: np_ for p, np_ in
+                 zip([p for p, h in proj], paths)} if skinner else None
+
+        def flush():
+            n = parser.batch_size()
+            if n == 0:
+                return
+            nlines, nbad = parser.counters()
+            parser_stage.counters['ninputs'] = nlines
+            parser_stage.counters['noutputs'] = nlines - nbad
+            if nbad:
+                parser_stage.counters['invalid json'] = nbad
+            if adapter_stage is not None:
+                adapter_stage.bump('ninputs', n)
+                adapter_stage.bump('noutputs', n)
+            if skinner:
+                from . import native as mod_native2
+                from . import jsvalues as jsv
+                tags, nums, strcodes = parser.columns('value')
+                weights = np.zeros(n, dtype=np.float64)
+                m = (tags == mod_native2.TAG_INT) | \
+                    (tags == mod_native2.TAG_NUMBER)
+                weights[m] = nums[m]
+                weights[tags == mod_native2.TAG_TRUE] = 1.0
+                ms = tags == mod_native2.TAG_STRING
+                if ms.any():
+                    # string weights coerce via JS Number (NaN -> 0),
+                    # matching engine.weights_array on the dict path
+                    d = parser.dictionary('value')
+                    table = np.array(
+                        [0.0 if (f := jsv.to_number(s)) != f else f
+                         for s in d], dtype=np.float64)
+                    weights[ms] = table[strcodes[ms]]
+            else:
+                weights = np.ones(n, dtype=np.float64)
+            src = _RemappedParser(parser, remap) if skinner else parser
+            scanner.write_native_batch(src, weights)
+            parser.reset_batch()
+
+        carry = b''
+        for path, st in files:
+            with open(path, 'rb') as f:
+                while True:
+                    chunk = f.read(1 << 22)
+                    if not chunk:
+                        break
+                    buf = carry + chunk
+                    nl = buf.rfind(b'\n')
+                    if nl == -1:
+                        carry = buf
+                        continue
+                    parser.parse(buf[:nl + 1])
+                    carry = buf[nl + 1:]
+                    if parser.batch_size() >= BATCH_SIZE:
+                        flush()
+        if carry:
+            parser.parse(carry)
+        flush()
+        # counters even when the final batch was empty
+        nlines, nbad = parser.counters()
+        if nlines:
+            parser_stage.counters['ninputs'] = nlines
+            parser_stage.counters['noutputs'] = nlines - nbad
+            if nbad:
+                parser_stage.counters['invalid json'] = nbad
+        return scanner
 
     # -- build / index-scan -----------------------------------------------
 
@@ -351,6 +456,27 @@ class DatasourceFile(object):
                 aggr.write(fields, value)
 
         return ScanResult(pipeline, points=aggr.points(), query=query)
+
+
+class _RemappedParser(object):
+    """Presents a NativeParser whose projection paths were prefixed
+    (json-skinner: fields.*) under the engine's unprefixed names."""
+
+    def __init__(self, parser, remap):
+        self.parser = parser
+        self.remap = remap
+
+    def batch_size(self):
+        return self.parser.batch_size()
+
+    def columns(self, path):
+        return self.parser.columns(self.remap[path])
+
+    def date_columns(self, path):
+        return self.parser.date_columns(self.remap[path])
+
+    def dictionary(self, path):
+        return self.parser.dictionary(self.remap[path])
 
 
 def _split_lines(instream):

@@ -6,19 +6,25 @@ path in scan.py is the semantic reference; differential tests assert
 identical results).  Per batch:
 
 1. evaluate datasource/user filters as ternary outcome vectors
-   (TRUE/FALSE/ERROR) via per-unique-value leaf tables,
+   (TRUE/FALSE/ERROR),
 2. parse synthetic date fields (vectorized, with undef/baddate drops),
 3. apply the time-bounds filter,
 4. bucketize aggregated columns and dictionary-encode key columns,
 5. fuse per-column codes into a mixed-radix composite key and
    segment-sum the weights into a dense accumulator,
-6. merge the (sparse) nonzero buckets into the running Aggregator.
+6. merge the nonzero buckets into the running Aggregator in
+   first-occurrence order (reproducing the host path's JS
+   nested-insertion emission order exactly).
+
+Columns come from a *provider*: DictColumns plucks parsed Python
+records (the fallback), NativeColumns adapts the C++ parser's tagged
+arrays (dragnet_tpu/native.py) — same downstream pipeline either way.
 
 Step 5 runs either on numpy (bincount; no compile overhead, right for
 CLI-sized inputs) or as a jitted jax kernel (segment-sum -> scatter-add
-on TPU; selected automatically for large batches or via DN_ENGINE=jax).
-Partial accumulators merge by addition, so the same kernel shards over a
-device mesh with a psum merge (see parallel/).
+on TPU; DN_ENGINE=jax, or always for the mesh/cluster path).  Partial
+accumulators merge by addition, so the same kernel shards over a device
+mesh with a psum merge (see parallel/).
 """
 
 import os
@@ -32,7 +38,6 @@ from .aggr import Aggregator
 from .ops.kernels import FALSE, TRUE, ERROR
 
 BATCH_SIZE = 65536
-JAX_THRESHOLD = 32768
 MAX_DENSE_SEGMENTS = 1 << 24
 
 
@@ -40,17 +45,197 @@ def engine_mode():
     return os.environ.get('DN_ENGINE', 'auto')
 
 
-class LeafTable(object):
-    """Evaluates one predicate leaf per unique value of its column."""
+def weights_array(values):
+    """Point weights -> f64 with JS Number coercion (json-skinner values
+    may be strings or garbage; NaN becomes 0 rather than poisoning
+    sums).  Applied identically to the dict and native ingest paths."""
+    out = np.empty(len(values), dtype=np.float64)
+    for i, v in enumerate(values):
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[i] = jsv.as_float(v)
+        else:
+            f = jsv.to_number(v)
+            out[i] = 0.0 if f != f else f
+    return out
 
-    def __init__(self, field, op, const, rawcol):
+
+# ---------------------------------------------------------------------------
+# Column providers
+# ---------------------------------------------------------------------------
+
+class DictColumns(object):
+    """Columns plucked from a list of parsed record dicts."""
+
+    def __init__(self, records, scan):
+        self.records = records
+        self.scan = scan
+        self.n = len(records)
+        self._raw = {}
+
+    def raw(self, path):
+        col = self._raw.get(path)
+        if col is None:
+            col = mod_batch.pluck_column(self.records, path)
+            self._raw[path] = col
+        return col
+
+    def leaf_outcomes(self, leaf):
+        rawcol = self.scan.raw_columns[leaf.field]
+        codes = self.scan._dict_codes(self, leaf.field, rawcol)
+        return leaf.table_for(rawcol.dict.values)[codes]
+
+    def date_column(self, path):
+        return mod_batch.date_column(self.raw(path))
+
+    def string_codes(self, path, column):
+        return column.encode(self.raw(path))
+
+    def numeric_column(self, path):
+        return mod_batch.numeric_column(self.raw(path))
+
+
+class NativeColumns(object):
+    """Columns adapted from the C++ parser's tagged arrays."""
+
+    def __init__(self, parser, scan):
+        from . import native as mod_native
+        self.mn = mod_native
+        self.parser = parser
+        self.scan = scan
+        self.n = parser.batch_size()
+        self._cols = {}
+        self._dates = {}
+
+    def _field(self, path):
+        col = self._cols.get(path)
+        if col is None:
+            col = self.parser.columns(path)
+            self._cols[path] = col
+        return col
+
+    def leaf_outcomes(self, leaf):
+        mn = self.mn
+        tags, nums, strcodes = self._field(leaf.field)
+        out = np.full(self.n, ERROR, dtype=np.int8)  # TAG_MISSING
+        out[tags == mn.TAG_NULL] = leaf.outcome(None)
+        out[tags == mn.TAG_TRUE] = leaf.outcome(True)
+        out[tags == mn.TAG_FALSE] = leaf.outcome(False)
+        out[tags == mn.TAG_OBJECT] = leaf.outcome({})
+        m = tags == mn.TAG_ARRAY
+        if m.any():
+            for v, arr in self._array_values(leaf.field):
+                out[m & (strcodes == v)] = leaf.outcome(arr)
+        m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
+        if m.any():
+            uniq, inv = np.unique(nums[m], return_inverse=True)
+            table = np.array([leaf.outcome(float(u)) for u in uniq],
+                             dtype=np.int8)
+            out[m] = table[inv]
+        m = tags == mn.TAG_STRING
+        if m.any():
+            table = leaf.table_for(self.parser.dictionary(leaf.field))
+            out[m] = table[strcodes[m]]
+        return out
+
+    def date_column(self, path):
+        d = self._dates.get(path)
+        if d is None:
+            d = self.parser.date_columns(path)
+            self._dates[path] = d
+        return d
+
+    def _array_values(self, path):
+        """(dict_code, parsed_value) for array-tagged entries of this
+        field's dictionary (raw JSON text interned by the parser).
+        Cached on the scan keyed by dictionary length (the dictionary is
+        append-only), like _native_str_trans.  The raw text passed the
+        parser's strict JSON validation, so json.loads cannot fail here
+        — a failure would mean native/fallback divergence and should be
+        loud."""
+        import json
+        d = self.parser.dictionary(path)
+        key = ('arrays', path)
+        cached = self.scan._str_trans.get(key)
+        if cached is None or cached[0] < len(d):
+            out = [(i, json.loads(raw)) for i, raw in enumerate(d)
+                   if raw.startswith('[')]
+            cached = (len(d), out)
+            self.scan._str_trans[key] = cached
+        return cached[1]
+
+    def string_codes(self, path, column):
+        """Translate tagged values to the engine's global String(v)
+        dictionary codes."""
+        mn = self.mn
+        tags, nums, strcodes = self._field(path)
+        out = np.empty(self.n, dtype=np.int64)
+        code = column.dict.code
+        out[tags == mn.TAG_MISSING] = code('undefined', 'undefined')
+        out[tags == mn.TAG_NULL] = code('null', 'null')
+        out[tags == mn.TAG_TRUE] = code('true', 'true')
+        out[tags == mn.TAG_FALSE] = code('false', 'false')
+        out[tags == mn.TAG_OBJECT] = code('[object Object]',
+                                          '[object Object]')
+        m = tags == mn.TAG_ARRAY
+        if m.any():
+            for v, arr in self._array_values(path):
+                s = jsv.to_string(arr)
+                out[m & (strcodes == v)] = code(s, s)
+        m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
+        if m.any():
+            tagm = tags[m]
+            uniq, inv = np.unique(nums[m], return_inverse=True)
+            # TAG_INT means integral |v| <= 2^53: prints without a dot
+            table = np.array([
+                code(s, s) for s in
+                (jsv.number_to_string(int(u) if float(u).is_integer()
+                                      and abs(u) <= 2 ** 53 else u)
+                 for u in uniq)], dtype=np.int64)
+            out[m] = table[inv]
+        m = tags == mn.TAG_STRING
+        if m.any():
+            d = self.parser.dictionary(path)
+            trans = self.scan._native_str_trans(path, column, d)
+            out[m] = trans[strcodes[m]]
+        return out
+
+    def numeric_column(self, path):
+        mn = self.mn
+        tags, nums, strcodes = self._field(path)
+        out = np.zeros(self.n, dtype=np.float64)
+        valid = np.zeros(self.n, dtype=bool)
+        m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
+        out[m] = nums[m]
+        valid[m] = True
+        ms = tags == mn.TAG_STRING
+        if ms.any():
+            d = self.parser.dictionary(path)
+            fvals = np.empty(len(d), dtype=np.float64)
+            fok = np.empty(len(d), dtype=bool)
+            for i, s in enumerate(d):
+                f = jsv.to_number(s)
+                fok[i] = f == f
+                fvals[i] = 0.0 if f != f else f
+            out[ms] = fvals[strcodes[ms]]
+            valid[ms] = fok[strcodes[ms]]
+        return out, valid
+
+
+# ---------------------------------------------------------------------------
+# Predicates
+# ---------------------------------------------------------------------------
+
+class Leaf(object):
+    """One predicate leaf; evaluates per unique value with exact JS
+    semantics, memoized as lookup tables."""
+
+    def __init__(self, field, op, const):
         self.field = field
         self.op = op
         self.const = const
-        self.rawcol = rawcol
-        self.table = np.zeros(0, dtype=np.int8)
+        self._str_table = np.zeros(0, dtype=np.int8)
 
-    def _outcome(self, v):
+    def outcome(self, v):
         if v is jsv.UNDEFINED:
             return ERROR
         if self.op == 'eq':
@@ -59,24 +244,25 @@ class LeafTable(object):
             return FALSE if jsv.loose_eq(v, self.const) else TRUE
         return TRUE if jsv.relational(v, self.const, self.op) else FALSE
 
-    def outcomes(self, codes):
-        values = self.rawcol.dict.values
-        if len(self.table) < len(values):
-            new = [self._outcome(v)
-                   for v in values[len(self.table):]]
-            self.table = np.concatenate(
-                [self.table, np.array(new, dtype=np.int8)])
-        return self.table[codes]
+    def table_for(self, values):
+        """Outcome table over a growing value list (values may be raw JS
+        values or strings)."""
+        if len(self._str_table) < len(values):
+            new = [self.outcome(v) for v in values[len(self._str_table):]]
+            self._str_table = np.concatenate(
+                [self._str_table, np.array(new, dtype=np.int8)])
+        return self._str_table
 
 
 class VectorPredicate(object):
-    """Compiles a krill AST into a ternary outcome vector over a batch."""
+    """Compiles a krill AST into a ternary outcome vector over a batch;
+    and/or fold with JS short-circuit rules (first non-true / first
+    non-false)."""
 
-    def __init__(self, pred_ast, raw_columns):
+    def __init__(self, pred_ast, scan):
         self.ast = pred_ast
+        self.scan = scan
         self.leaves = {}
-        self.raw_columns = raw_columns
-        self.fields = []
         self._collect(pred_ast)
 
     def _collect(self, ast):
@@ -90,36 +276,35 @@ class VectorPredicate(object):
         field, const = ast[op]
         key = (field, op, jsv.json_stringify(const))
         if key not in self.leaves:
-            if field not in self.raw_columns:
-                self.raw_columns[field] = mod_batch.RawColumn()
-            self.leaves[key] = LeafTable(field, op, const,
-                                         self.raw_columns[field])
-        if field not in self.fields:
-            self.fields.append(field)
+            self.leaves[key] = Leaf(field, op, const)
+            if field not in self.scan.raw_columns:
+                self.scan.raw_columns[field] = mod_batch.RawColumn()
+            if field not in self.scan.filter_fields:
+                self.scan.filter_fields.append(field)
 
-    def outcomes(self, code_arrays, n):
-        return self._eval(self.ast, code_arrays, n)
+    def outcomes(self, provider):
+        return self._eval(self.ast, provider)
 
-    def _eval(self, ast, code_arrays, n):
+    def _eval(self, ast, provider):
         if not ast:
-            return np.full(n, TRUE, dtype=np.int8)
+            return np.full(provider.n, TRUE, dtype=np.int8)
         op = next(iter(ast))
         if op in ('and', 'or'):
-            outs = [self._eval(sub, code_arrays, n) for sub in ast[op]]
+            outs = [self._eval(sub, provider) for sub in ast[op]]
             state = outs[0].copy()
-            if op == 'and':
-                for o in outs[1:]:
-                    m = state == TRUE
-                    state[m] = o[m]
-            else:
-                for o in outs[1:]:
-                    m = state == FALSE
-                    state[m] = o[m]
+            stop = TRUE if op == 'and' else FALSE
+            for o in outs[1:]:
+                m = state == stop
+                state[m] = o[m]
             return state
         field, const = ast[op]
         key = (field, op, jsv.json_stringify(const))
-        return self.leaves[key].outcomes(code_arrays[field])
+        return provider.leaf_outcomes(self.leaves[key])
 
+
+# ---------------------------------------------------------------------------
+# The scan
+# ---------------------------------------------------------------------------
 
 class VectorScan(object):
     """Batch-at-a-time scan with results identical to scan.StreamScan."""
@@ -127,16 +312,17 @@ class VectorScan(object):
     def __init__(self, query, time_field, pipeline, ds_filter=None):
         self.query = query
         self.raw_columns = {}
+        self.filter_fields = []
         self.string_columns = {}
-        self.stages = []
+        self._dict_code_cache = {}
+        self._str_trans = {}
 
         self.ds_pred = self.user_pred = None
         if ds_filter is not None:
-            self.ds_pred = VectorPredicate(ds_filter, self.raw_columns)
+            self.ds_pred = VectorPredicate(ds_filter, self)
             self.ds_stage = pipeline.stage('Datasource filter')
         if query.qc_filter is not None:
-            self.user_pred = VectorPredicate(query.qc_filter,
-                                             self.raw_columns)
+            self.user_pred = VectorPredicate(query.qc_filter, self)
             self.user_stage = pipeline.stage('User filter')
 
         self.synthetic = list(query.qc_synthetic)
@@ -158,22 +344,61 @@ class VectorScan(object):
             if b['name'] not in query.qc_bucketizers:
                 self.string_columns[b['name']] = mod_batch.StringColumn()
 
-        self._jax_agg = None
+    # -- projection (what the native parser must extract) -----------------
 
-    # -- per-batch execution ---------------------------------------------
+    def projection(self):
+        """[(path, date_hint)] of every field the scan reads from raw
+        records."""
+        paths = {}
+        for f in self.filter_fields:
+            paths.setdefault(f, False)
+        for fieldconf in self.synthetic:
+            paths[fieldconf['field']] = True
+        for b in self.query.qc_breakdowns:
+            synth = any(s['name'] == b['name'] for s in self.synthetic)
+            if not synth:
+                paths.setdefault(b['name'], False)
+        return list(paths.items())
+
+    # -- provider helpers --------------------------------------------------
+
+    def _dict_codes(self, provider, field, rawcol):
+        cache_key = (id(provider), field)
+        codes = self._dict_code_cache.get(cache_key)
+        if codes is None:
+            codes = rawcol.encode(provider.raw(field))
+            self._dict_code_cache[cache_key] = codes
+        return codes
+
+    def _native_str_trans(self, path, column, parser_dict):
+        """Engine-dictionary codes for the native parser's per-field
+        string dictionary (incrementally extended)."""
+        trans = self._str_trans.get(path)
+        if trans is None or len(trans) < len(parser_dict):
+            code = column.dict.code
+            trans = np.array([code(s, s) for s in parser_dict],
+                             dtype=np.int64)
+            self._str_trans[path] = trans
+        return trans
+
+    # -- per-batch execution ----------------------------------------------
 
     def write_batch(self, records, weights):
-        n = len(records)
-        if n == 0:
+        if len(records) == 0:
             return
-        alive = np.ones(n, dtype=bool)
-        weights = np.asarray(weights, dtype=np.float64)
+        self._dict_code_cache.clear()
+        provider = DictColumns(records, self)
+        self._process(provider, weights_array(weights))
 
-        # filter columns: encode raw values once per field
-        code_arrays = {}
-        for field, rawcol in self.raw_columns.items():
-            code_arrays[field] = rawcol.encode(
-                mod_batch.pluck_column(records, field))
+    def write_native_batch(self, parser, weights):
+        if parser.batch_size() == 0:
+            return
+        provider = NativeColumns(parser, self)
+        self._process(provider, np.asarray(weights, dtype=np.float64))
+
+    def _process(self, provider, weights):
+        n = provider.n
+        alive = np.ones(n, dtype=bool)
 
         for pred, stage in ((self.ds_pred,
                              getattr(self, 'ds_stage', None)),
@@ -182,11 +407,9 @@ class VectorScan(object):
             if pred is None:
                 continue
             stage.bump('ninputs', int(alive.sum()))
-            out = pred.outcomes(code_arrays, n)
-            failed = alive & (out == ERROR)
-            dropped = alive & (out == FALSE)
-            nfail = int(failed.sum())
-            ndrop = int(dropped.sum())
+            out = pred.outcomes(provider)
+            nfail = int((alive & (out == ERROR)).sum())
+            ndrop = int((alive & (out == FALSE)).sum())
             if nfail:
                 stage.bump('nfailedeval', nfail)
             if ndrop:
@@ -194,14 +417,12 @@ class VectorScan(object):
             alive &= (out == TRUE)
             stage.bump('noutputs', int(alive.sum()))
 
-        # synthetic date fields
         synth_values = {}
         if self.synthetic:
             self.synth_stage.bump('ninputs', int(alive.sum()))
             first_err = np.zeros(n, dtype=np.uint8)
             for fieldconf in self.synthetic:
-                vals, err = mod_batch.date_column(
-                    mod_batch.pluck_column(records, fieldconf['field']))
+                vals, err = provider.date_column(fieldconf['field'])
                 synth_values[fieldconf['name']] = vals
                 first_err = np.where(first_err == 0, err, first_err)
             nundef = int((alive & (first_err == mod_batch.UNDEF)).sum())
@@ -225,7 +446,6 @@ class VectorScan(object):
 
         self.aggr.stage.bump('ninputs', int(alive.sum()))
 
-        # key columns
         key_codes = []
         decoders = []
         for b in self.query.qc_breakdowns:
@@ -235,8 +455,7 @@ class VectorScan(object):
                     vals = synth_values[name]
                     valid = np.ones(n, dtype=bool)
                 else:
-                    vals, valid = mod_batch.numeric_column(
-                        mod_batch.pluck_column(records, name))
+                    vals, valid = provider.numeric_column(name)
                 nbadnum = int((alive & ~valid).sum())
                 if nbadnum:
                     self.aggr.stage.bump('nnonnumeric', nbadnum)
@@ -246,17 +465,15 @@ class VectorScan(object):
                 key_codes.append(codes.astype(np.int64))
                 decoders.append([int(u) for u in uniq])
             else:
+                col = self.string_columns[name]
                 if name in synth_values:
-                    col = self.string_columns[name]
                     vals = synth_values[name]
                     codes = col.encode([
                         int(v) if float(v).is_integer() else float(v)
                         for v in vals])
                 else:
-                    col = self.string_columns[name]
-                    codes = col.encode(
-                        mod_batch.pluck_column(records, name))
-                key_codes.append(codes)
+                    codes = provider.string_codes(name, col)
+                key_codes.append(np.asarray(codes, dtype=np.int64))
                 decoders.append(col.dict.values)
 
         if not key_codes:
@@ -296,7 +513,8 @@ class VectorScan(object):
             self.aggr.write_key(tuple(key), self._weight(w))
 
     def _weight(self, w):
-        return int(w) if float(w).is_integer() else w
+        w = float(w)  # numpy scalar -> python (affects str() rendering)
+        return int(w) if w.is_integer() else w
 
     def _bucketize(self, b, vals):
         bz = self.query.qc_bucketizers[b['name']]
@@ -310,9 +528,8 @@ class VectorScan(object):
         # (dispatch latency dwarfs these kernel sizes, especially over a
         # tunneled accelerator); DN_ENGINE=jax forces the device kernel,
         # and the mesh/cluster path always runs on devices.
-        mode = engine_mode()
         use_jax = False
-        if mode == 'jax':
+        if engine_mode() == 'jax':
             from .ops import get_jax
             use_jax = get_jax() is not None
 
@@ -346,8 +563,6 @@ class VectorScan(object):
             key = tuple(dec[int(codes[i])]
                         for codes, dec in zip(key_codes, decoders))
             self.aggr.write_key(key, self._weight(float(weights[i])))
-
-    # -- compatibility with StreamScan host interface --------------------
 
     def finish(self):
         return self.aggr

@@ -51,6 +51,15 @@ def is_number(v):
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
+def as_float(v):
+    """float(v) with JS overflow semantics: Python ints beyond float64
+    range become +-Infinity (JS numbers are doubles throughout)."""
+    try:
+        return float(v)
+    except OverflowError:
+        return math.inf if v > 0 else -math.inf
+
+
 def number_to_string(v):
     """JS Number#toString(10): shortest round-trip decimal.
 
@@ -66,7 +75,7 @@ def number_to_string(v):
         # exact value.
         if -(1 << 53) <= v <= (1 << 53):
             return str(v)
-        v = float(v)
+        v = as_float(v)
     if math.isnan(v):
         return 'NaN'
     if math.isinf(v):
@@ -132,7 +141,7 @@ def to_number(v):
     if isinstance(v, bool):
         return 1.0 if v else 0.0
     if is_number(v):
-        return float(v)
+        return as_float(v)
     if isinstance(v, str):
         s = v.strip()
         if s == '':
@@ -157,15 +166,23 @@ def loose_eq(a, b):
     if isinstance(a, str) and isinstance(b, str):
         return a == b
     if a_num and b_num:
-        fa, fb = float(a), float(b)
+        fa, fb = as_float(a), as_float(b)
         return fa == fb and not (math.isnan(fa) or math.isnan(fb))
     if a_num and isinstance(b, str):
         fb = to_number(b)
-        return float(a) == fb and not math.isnan(fb)
+        return as_float(a) == fb and not math.isnan(fb)
     if isinstance(a, str) and b_num:
         fa = to_number(a)
-        return fa == float(b) and not math.isnan(fa)
-    # objects compared by identity
+        return fa == as_float(b) and not math.isnan(fa)
+    # object vs primitive: ToPrimitive coerces via toString
+    # ([1,2] == "1,2" is true in JS; {} == "[object Object]" too)
+    a_obj = isinstance(a, (list, dict))
+    b_obj = isinstance(b, (list, dict))
+    if a_obj and not b_obj:
+        return loose_eq(to_string(a), b)
+    if b_obj and not a_obj:
+        return loose_eq(a, to_string(b))
+    # object vs object: identity
     return a is b
 
 
@@ -173,8 +190,13 @@ def relational(a, b, op):
     """JS relational comparison (<, <=, >, >=).
 
     If both operands are strings, compare lexicographically; otherwise
-    numerically (NaN makes every comparison false).
+    numerically (NaN makes every comparison false).  Objects coerce via
+    ToPrimitive (toString).
     """
+    if isinstance(a, (list, dict)):
+        a = to_string(a)
+    if isinstance(b, (list, dict)):
+        b = to_string(b)
     if isinstance(a, str) and isinstance(b, str):
         if op == 'lt':
             return a < b

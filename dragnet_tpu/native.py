@@ -1,0 +1,179 @@
+"""ctypes binding for the native ingest parser (native/dnparse.cc).
+
+Loads (building on demand if a toolchain is present) the C++
+newline-JSON -> columnar parser and adapts its tagged-value output to the
+engine's column interfaces.  Falls back cleanly when the shared library
+cannot be built — the pure-Python ingest path remains authoritative for
+semantics (differential-tested).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from . import jsvalues as jsv
+
+TAG_MISSING = 0
+TAG_NULL = 1
+TAG_FALSE = 2
+TAG_TRUE = 3
+TAG_NUMBER = 4
+TAG_INT = 5
+TAG_STRING = 6
+TAG_OBJECT = 7
+TAG_ARRAY = 8
+
+_lib = None
+_lib_lock = threading.Lock()
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'native')
+_SO_PATH = os.path.join(_NATIVE_DIR, 'build', 'libdnparse.so')
+
+
+def _build():
+    src = os.path.join(_NATIVE_DIR, 'dnparse.cc')
+    if not os.path.exists(src):
+        return False
+    if os.path.exists(_SO_PATH) and \
+            os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+        return True
+    try:
+        subprocess.run(['make', '-C', _NATIVE_DIR],
+                       check=True, stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+    except Exception:
+        return False
+    return os.path.exists(_SO_PATH)
+
+
+def get_lib():
+    """Load (building if needed) the native parser; None if unavailable
+    or disabled via DN_NATIVE=0."""
+    global _lib
+    if os.environ.get('DN_NATIVE', '1') == '0':
+        return None
+    with _lib_lock:
+        if _lib is not None:
+            return _lib if _lib is not False else None
+        if not _build():
+            _lib = False
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _lib = False
+            return None
+
+        lib.dn_parser_create.restype = ctypes.c_void_p
+        lib.dn_parser_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+        lib.dn_parser_destroy.argtypes = [ctypes.c_void_p]
+        lib.dn_parser_parse.restype = ctypes.c_int64
+        lib.dn_parser_parse.argtypes = [ctypes.c_void_p,
+                                        ctypes.c_char_p, ctypes.c_int64]
+        for name in ('dn_parser_nlines', 'dn_parser_nbad',
+                     'dn_parser_batch_size'):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.dn_parser_tags.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.dn_parser_tags.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.dn_parser_nums.restype = ctypes.POINTER(ctypes.c_double)
+        lib.dn_parser_nums.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.dn_parser_strcodes.restype = ctypes.POINTER(ctypes.c_int32)
+        lib.dn_parser_strcodes.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_int32]
+        lib.dn_parser_datesecs.restype = ctypes.POINTER(ctypes.c_double)
+        lib.dn_parser_datesecs.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_int32]
+        lib.dn_parser_dateerr.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.dn_parser_dateerr.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_int32]
+        lib.dn_parser_dict_size.restype = ctypes.c_int32
+        lib.dn_parser_dict_size.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_int32]
+        lib.dn_parser_dict_get.restype = ctypes.POINTER(ctypes.c_char)
+        lib.dn_parser_dict_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.dn_parser_reset_batch.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeParser(object):
+    """One parser per scan: dictionaries persist across batches."""
+
+    def __init__(self, paths, date_hints):
+        self.lib = get_lib()
+        assert self.lib is not None
+        self.paths = list(paths)
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        hints = (ctypes.c_uint8 * len(paths))(
+            *[1 if h else 0 for h in date_hints])
+        self.h = self.lib.dn_parser_create(arr, hints, len(paths))
+        self.field_index = {p: i for i, p in enumerate(paths)}
+        # per-field python mirror of the native dictionary
+        self._dicts = [[] for _ in paths]
+
+    def __del__(self):
+        try:
+            if getattr(self, 'h', None):
+                self.lib.dn_parser_destroy(self.h)
+        except Exception:
+            pass
+
+    def parse(self, buf):
+        """Parse a bytes buffer of complete lines; returns the number of
+        records appended to the current batch."""
+        return self.lib.dn_parser_parse(self.h, buf, len(buf))
+
+    def counters(self):
+        return (self.lib.dn_parser_nlines(self.h),
+                self.lib.dn_parser_nbad(self.h))
+
+    def batch_size(self):
+        return self.lib.dn_parser_batch_size(self.h)
+
+    def dictionary(self, field):
+        """Python mirror of the native per-field string dictionary."""
+        fi = self.field_index[field]
+        d = self._dicts[fi]
+        size = self.lib.dn_parser_dict_size(self.h, fi)
+        while len(d) < size:
+            ln = ctypes.c_int32()
+            p = self.lib.dn_parser_dict_get(self.h, fi, len(d),
+                                            ctypes.byref(ln))
+            d.append(ctypes.string_at(p, ln.value).decode(
+                'utf-8', 'surrogateescape'))
+        return d
+
+    def _np(self, fn, field, dtype, n):
+        fi = self.field_index[field]
+        ptr = fn(self.h, fi)
+        if n == 0:
+            return np.zeros(0, dtype=dtype)
+        return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype,
+                                                            copy=True)
+
+    def columns(self, field):
+        """(tags u8, nums f64, strcodes i32) for the current batch."""
+        n = self.batch_size()
+        return (self._np(self.lib.dn_parser_tags, field, np.uint8, n),
+                self._np(self.lib.dn_parser_nums, field, np.float64, n),
+                self._np(self.lib.dn_parser_strcodes, field, np.int32,
+                         n))
+
+    def date_columns(self, field):
+        n = self.batch_size()
+        return (self._np(self.lib.dn_parser_datesecs, field, np.float64,
+                         n),
+                self._np(self.lib.dn_parser_dateerr, field, np.uint8, n))
+
+    def reset_batch(self):
+        self.lib.dn_parser_reset_batch(self.h)

@@ -97,6 +97,17 @@ def sharded_aggregate(key_codes, radices, weights, alive, scatter=False):
     if scatter and num_segments % ndev != 0:
         scatter = False
 
+    # The i32 device kernel is exact only for integer weights whose
+    # batch total fits; anything else takes the exact f64 host merge
+    # (same guard as the single-device jax path in engine.py).
+    int_w = bool(np.all(weights == np.floor(weights)))
+    if not (int_w and float(np.abs(weights).sum()) < 2 ** 31):
+        fused = np.zeros(n, dtype=np.int64)
+        for i in range(len(radices)):
+            fused = fused * int(radices[i]) + key_codes[i]
+        w = np.where(alive, weights, 0.0)
+        return np.bincount(fused, weights=w, minlength=num_segments)
+
     pad = (-n) % ndev
     if pad:
         key_codes = np.pad(key_codes, ((0, 0), (0, pad)))
@@ -104,9 +115,7 @@ def sharded_aggregate(key_codes, radices, weights, alive, scatter=False):
         alive = np.pad(alive, (0, pad))
 
     per_device = (n + pad) // ndev
-    int_w = bool(np.all(weights == np.floor(weights)))
     fn, mesh = _sharded_aggregate_cached(tuple(int(r) for r in radices),
-                                         per_device, ndev, scatter, int_w)
-    out = fn(key_codes.astype(np.int32),
-             weights.astype(np.int32 if int_w else np.float32), alive)
+                                         per_device, ndev, scatter, True)
+    out = fn(key_codes.astype(np.int32), weights.astype(np.int32), alive)
     return np.asarray(out).astype(np.float64)

@@ -1,0 +1,716 @@
+// dnparse: newline-JSON -> projected columnar batches.
+//
+// The native half of the ingest path.  The reference's hot loop parsed
+// every record into a V8 object and walked it per stage
+// (lib/format-json.js, vstream-json-parser); here a single streaming
+// pass over the byte buffer extracts only the projected field paths and
+// emits columnar arrays (value tags, numbers, interned string codes,
+// pre-parsed ISO-8601 dates) that the Python/JAX engine consumes
+// directly.
+//
+// Semantics preserved exactly:
+//  * jsprim-pluck projection: a literal key "req.method" beats the
+//    nested req -> method path (direct-key-first), and within the same
+//    priority the *last* JSON occurrence wins (JSON.parse duplicate-key
+//    rule),
+//  * invalid lines are counted and skipped (vstream "invalid json"),
+//  * numbers are IEEE doubles (JS semantics),
+//  * ISO-8601 date parsing with ES5 rules (missing offset == UTC),
+//    numbers pass through as epoch seconds (lib/stream-synthetic.js).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// value tags (must match dragnet_tpu/native.py)
+enum Tag : uint8_t {
+  TAG_MISSING = 0,
+  TAG_NULL = 1,
+  TAG_FALSE = 2,
+  TAG_TRUE = 3,
+  TAG_NUMBER = 4,   // non-integral or large
+  TAG_INT = 5,      // integral, |v| <= 2^53
+  TAG_STRING = 6,
+  TAG_OBJECT = 7,   // object (kept opaque: String(v) == "[object Object]")
+  TAG_ARRAY = 8,    // array: raw JSON text interned for JS coercion
+};
+
+enum DateErr : uint8_t {
+  DATE_OK = 0,
+  DATE_UNDEF = 1,
+  DATE_BAD = 2,
+};
+
+struct StringDict {
+  std::unordered_map<std::string, int32_t> index;
+  std::vector<std::string> values;
+
+  int32_t code(const std::string& s) {
+    auto it = index.find(s);
+    if (it != index.end()) return it->second;
+    int32_t c = static_cast<int32_t>(values.size());
+    index.emplace(s, c);
+    values.push_back(s);
+    return c;
+  }
+};
+
+struct FieldOut {
+  std::vector<uint8_t> tags;
+  std::vector<double> nums;
+  std::vector<int32_t> strcodes;
+  std::vector<double> datesecs;   // only filled when date_hint
+  std::vector<uint8_t> dateerr;   // only filled when date_hint
+  StringDict dict;
+  bool date_hint = false;
+  // scratch per record: priority of the value currently held
+  // (0 = none, 1 = nested match, 2 = direct full-key match)
+  uint8_t cur_prio = 0;
+};
+
+// projection trie node: at each object depth, a key either terminates a
+// field (direct or final segment) or descends.
+struct TrieNode {
+  std::unordered_map<std::string, TrieNode*> children;
+  // field index terminated by this key at this level, with priority
+  int32_t field = -1;
+  uint8_t prio = 0;
+  ~TrieNode() {
+    for (auto& kv : children) delete kv.second;
+  }
+};
+
+struct Parser {
+  std::vector<std::string> paths;
+  std::vector<FieldOut> fields;
+  TrieNode root;
+  uint64_t nlines = 0;
+  uint64_t nbad = 0;
+  uint64_t nrecords = 0;
+  uint64_t batch_records = 0;
+  std::string err;
+};
+
+// ---------------------------------------------------------------------
+// date parsing: ISO-8601 subset (ES5 Date.parse), returns ms since
+// epoch; false on failure.
+bool days_from_civil(int64_t y, unsigned m, unsigned d, int64_t* out) {
+  // Howard Hinnant's algorithm
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = static_cast<unsigned>(y - era * 400);
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  *out = era * 146097 + static_cast<int64_t>(doe) - 719468;
+  return true;
+}
+
+inline bool two_digits(const char* p, int* out) {
+  if (p[0] < '0' || p[0] > '9' || p[1] < '0' || p[1] > '9') return false;
+  *out = (p[0] - '0') * 10 + (p[1] - '0');
+  return true;
+}
+
+bool parse_iso_date(const char* s, size_t len, int64_t* ms_out) {
+  // YYYY[-MM[-DD]][T HH:MM[:SS[.fff...]][Z|+-HH:MM|+-HHMM]]
+  if (len < 4) return false;
+  const char* p = s;
+  const char* end = s + len;
+  int year = 0;
+  for (int i = 0; i < 4; i++) {
+    if (p[i] < '0' || p[i] > '9') return false;
+    year = year * 10 + (p[i] - '0');
+  }
+  p += 4;
+  int month = 1, day = 1, hh = 0, mm = 0, ss = 0, msec = 0;
+  if (p < end && *p == '-') {
+    if (end - p < 3 || !two_digits(p + 1, &month)) return false;
+    p += 3;
+    if (p < end && *p == '-') {
+      if (end - p < 3 || !two_digits(p + 1, &day)) return false;
+      p += 3;
+    }
+  }
+  long tz_offset_min = 0;
+  if (p < end) {
+    if (*p != 'T' && *p != ' ') return false;
+    p++;
+    if (end - p < 5 || !two_digits(p, &hh)) return false;
+    if (p[2] != ':') return false;
+    if (!two_digits(p + 3, &mm)) return false;
+    p += 5;
+    if (p < end && *p == ':') {
+      if (end - p < 3 || !two_digits(p + 1, &ss)) return false;
+      p += 3;
+      if (p < end && *p == '.') {
+        p++;
+        int ndig = 0;
+        int frac = 0;
+        while (p < end && *p >= '0' && *p <= '9') {
+          if (ndig < 3) frac = frac * 10 + (*p - '0');
+          ndig++;
+          p++;
+        }
+        if (ndig == 0) return false;
+        while (ndig < 3) { frac *= 10; ndig++; }
+        msec = frac;
+      }
+    }
+    if (p < end) {
+      if (*p == 'Z') {
+        p++;
+      } else if (*p == '+' || *p == '-') {
+        // offsets require minutes: [+-]HH:MM or [+-]HHMM
+        // (matching the reference path's ISO regex)
+        int sign = (*p == '+') ? 1 : -1;
+        p++;
+        int tzh = 0, tzm = 0;
+        if (end - p < 2 || !two_digits(p, &tzh)) return false;
+        p += 2;
+        if (p < end && *p == ':') p++;
+        if (end - p < 2 || !two_digits(p, &tzm)) return false;
+        p += 2;
+        tz_offset_min = sign * (tzh * 60 + tzm);
+      } else {
+        return false;
+      }
+    }
+  }
+  if (p != end) return false;
+  if (month < 1 || month > 12) return false;
+  static const int kDays[] = {31, 28, 31, 30, 31, 30,
+                              31, 31, 30, 31, 30, 31};
+  int maxday = kDays[month - 1];
+  if (month == 2 &&
+      (year % 4 == 0 && (year % 100 != 0 || year % 400 == 0))) {
+    maxday = 29;
+  }
+  if (day < 1 || day > maxday) return false;
+  // the Python reference path builds a datetime, which rejects hour 24
+  if (hh > 23 || mm > 59 || ss > 59) return false;
+  int64_t days;
+  days_from_civil(year, month, day, &days);
+  int64_t ms = ((days * 24 + hh) * 60 + mm) * 60 + ss;
+  ms = ms * 1000 + msec;
+  ms -= tz_offset_min * 60000;
+  *ms_out = ms;
+  return true;
+}
+
+// ---------------------------------------------------------------------
+// JSON scanning
+
+struct Scanner {
+  const char* p;
+  const char* end;
+
+  bool at_end() const { return p >= end; }
+  char peek() const { return *p; }
+
+  void skip_ws() {
+    while (p < end &&
+           (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n')) p++;
+  }
+
+  bool skip_string() {
+    // assumes *p == '"'; validates JSON string syntax (escape set,
+    // no raw control chars) so the skip path rejects exactly what
+    // JSON.parse / json.loads reject
+    p++;
+    while (p < end) {
+      unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '\\') {
+        p++;
+        if (p >= end) return false;
+        char e = *p;
+        if (e == 'u') {
+          if (end - p < 5) return false;
+          for (int i = 1; i <= 4; i++) {
+            char h = p[i];
+            if (!((h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
+                  (h >= 'A' && h <= 'F'))) return false;
+          }
+          p += 5;
+        } else if (e == '"' || e == '\\' || e == '/' || e == 'b' ||
+                   e == 'f' || e == 'n' || e == 'r' || e == 't') {
+          p++;
+        } else {
+          return false;
+        }
+      } else if (c == '"') {
+        p++;
+        return true;
+      } else if (c < 0x20) {
+        return false;
+      } else {
+        p++;
+      }
+    }
+    return false;
+  }
+
+  // decode a JSON string into out (UTF-8); assumes *p == '"'
+  bool read_string(std::string* out) {
+    p++;
+    out->clear();
+    while (p < end) {
+      unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '"') {
+        p++;
+        return true;
+      }
+      if (c == '\\') {
+        p++;
+        if (p >= end) return false;
+        char e = *p++;
+        switch (e) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            if (end - p < 4) return false;
+            unsigned cp = 0;
+            for (int i = 0; i < 4; i++) {
+              char h = p[i];
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp |= h - '0';
+              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+              else return false;
+            }
+            p += 4;
+            if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 &&
+                p[0] == '\\' && p[1] == 'u') {
+              unsigned lo = 0;
+              bool ok = true;
+              for (int i = 0; i < 4; i++) {
+                char h = p[2 + i];
+                lo <<= 4;
+                if (h >= '0' && h <= '9') lo |= h - '0';
+                else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                else { ok = false; break; }
+              }
+              if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                p += 6;
+              }
+            }
+            // encode UTF-8
+            if (cp < 0x80) {
+              out->push_back(static_cast<char>(cp));
+            } else if (cp < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else if (cp < 0x10000) {
+              out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return false;
+        }
+      } else if (c < 0x20) {
+        return false;
+      } else {
+        out->push_back(static_cast<char>(c));
+        p++;
+      }
+    }
+    return false;
+  }
+
+  // skip any JSON value, validating full JSON grammar so the native
+  // path rejects exactly the lines the Python fallback rejects
+  bool skip_value() {
+    skip_ws();
+    if (at_end()) return false;
+    char c = *p;
+    if (c == '"') return skip_string();
+    if (c == '{') return skip_object_strict();
+    if (c == '[') return skip_array_strict();
+    if (c == 't') return literal("true");
+    if (c == 'f') return literal("false");
+    if (c == 'n') return literal("null");
+    return skip_number(nullptr, nullptr);
+  }
+
+  bool skip_object_strict() {
+    p++;  // '{'
+    skip_ws();
+    if (!at_end() && *p == '}') { p++; return true; }
+    while (true) {
+      skip_ws();
+      if (at_end() || *p != '"') return false;
+      if (!skip_string()) return false;
+      skip_ws();
+      if (at_end() || *p != ':') return false;
+      p++;
+      if (!skip_value()) return false;
+      skip_ws();
+      if (at_end()) return false;
+      if (*p == ',') { p++; continue; }
+      if (*p == '}') { p++; return true; }
+      return false;
+    }
+  }
+
+  bool skip_array_strict() {
+    p++;  // '['
+    skip_ws();
+    if (!at_end() && *p == ']') { p++; return true; }
+    while (true) {
+      if (!skip_value()) return false;
+      skip_ws();
+      if (at_end()) return false;
+      if (*p == ',') { p++; continue; }
+      if (*p == ']') { p++; return true; }
+      return false;
+    }
+  }
+
+  bool literal(const char* lit) {
+    size_t len = strlen(lit);
+    if (static_cast<size_t>(end - p) < len ||
+        memcmp(p, lit, len) != 0) return false;
+    p += len;
+    return true;
+  }
+
+  bool skip_number(double* out, bool* is_int) {
+    // strict JSON number grammar: -?(0|[1-9][0-9]*)(\.[0-9]+)?
+    // ([eE][+-]?[0-9]+)?  (no leading zeros, no bare "1.")
+    const char* start = p;
+    if (p < end && (*p == '-')) p++;
+    if (p >= end || *p < '0' || *p > '9') return false;
+    if (*p == '0') {
+      p++;
+    } else {
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    bool integral = true;
+    if (p < end && *p == '.') {
+      integral = false;
+      p++;
+      if (p >= end || *p < '0' || *p > '9') return false;
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      integral = false;
+      p++;
+      if (p < end && (*p == '+' || *p == '-')) p++;
+      if (p >= end || *p < '0' || *p > '9') return false;
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (out != nullptr) {
+      std::string tmp(start, p - start);
+      *out = strtod(tmp.c_str(), nullptr);
+      double v = *out;
+      *is_int = integral && std::fabs(v) <= 9007199254740992.0 &&
+                v == std::floor(v);
+    }
+    return true;
+  }
+};
+
+// parse one record line, filling matched fields
+bool parse_object(Parser* pr, Scanner* sc, TrieNode* node, int depth) {
+  sc->skip_ws();
+  if (sc->at_end() || sc->peek() != '{') return false;
+  sc->p++;
+  sc->skip_ws();
+  if (!sc->at_end() && sc->peek() == '}') { sc->p++; return true; }
+
+  std::string key;
+  std::string sval;
+  while (true) {
+    sc->skip_ws();
+    if (sc->at_end() || sc->peek() != '"') return false;
+    if (!sc->read_string(&key)) return false;
+    sc->skip_ws();
+    if (sc->at_end() || sc->peek() != ':') return false;
+    sc->p++;
+    sc->skip_ws();
+
+    TrieNode* child = nullptr;
+    if (node != nullptr) {
+      auto it = node->children.find(key);
+      if (it != node->children.end()) child = it->second;
+    }
+
+    if (child != nullptr && child->field >= 0) {
+      FieldOut& f = pr->fields[child->field];
+      // direct-key-first: a higher-priority match overwrites a lower
+      // one; same priority -> last occurrence wins (JSON.parse rule)
+      if (child->prio >= f.cur_prio) {
+        f.cur_prio = child->prio;
+        size_t i = f.tags.size() - 1;  // current record slot
+        char c = sc->at_end() ? '\0' : sc->peek();
+        if (c == '"') {
+          if (!sc->read_string(&sval)) return false;
+          f.tags[i] = TAG_STRING;
+          f.strcodes[i] = f.dict.code(sval);
+          if (f.date_hint) {
+            int64_t ms;
+            if (parse_iso_date(sval.data(), sval.size(), &ms)) {
+              f.dateerr[i] = DATE_OK;
+              // JS Math.floor(ms/1000)
+              double d = static_cast<double>(ms);
+              f.datesecs[i] = std::floor(d / 1000.0);
+            } else {
+              f.dateerr[i] = DATE_BAD;
+            }
+          }
+        } else if (c == '[') {
+          // arrays participate in JS coercion (String/Number via
+          // join), so intern the raw JSON text for host-side handling
+          const char* vstart = sc->p;
+          if (!sc->skip_value()) return false;
+          f.tags[i] = TAG_ARRAY;
+          f.strcodes[i] = f.dict.code(
+              std::string(vstart, sc->p - vstart));
+          if (f.date_hint) f.dateerr[i] = DATE_BAD;
+        } else if (c == '{') {
+          if (child->children.empty()) {
+            if (!sc->skip_value()) return false;
+            f.tags[i] = TAG_OBJECT;
+            if (f.date_hint) f.dateerr[i] = DATE_BAD;
+          } else {
+            // rare: key both terminates one field and prefixes others
+            if (!parse_object(pr, sc, child, depth + 1)) return false;
+            f.tags[i] = TAG_OBJECT;
+            if (f.date_hint) f.dateerr[i] = DATE_BAD;
+          }
+        } else if (c == 't' || c == 'f') {
+          bool istrue = (c == 't');
+          if (!sc->literal(istrue ? "true" : "false")) return false;
+          f.tags[i] = istrue ? TAG_TRUE : TAG_FALSE;
+          if (f.date_hint) f.dateerr[i] = DATE_BAD;
+        } else if (c == 'n') {
+          if (!sc->literal("null")) return false;
+          f.tags[i] = TAG_NULL;
+          if (f.date_hint) f.dateerr[i] = DATE_BAD;
+        } else {
+          double num;
+          bool is_int;
+          if (!sc->skip_number(&num, &is_int)) return false;
+          f.tags[i] = is_int ? TAG_INT : TAG_NUMBER;
+          f.nums[i] = num;
+          if (f.date_hint) {
+            // numbers pass through as already-parsed epoch seconds
+            f.dateerr[i] = DATE_OK;
+            f.datesecs[i] = num;
+          }
+        }
+        goto next_member;
+      }
+    }
+
+    if (child != nullptr && !child->children.empty() &&
+        !sc->at_end() && sc->peek() == '{') {
+      if (!parse_object(pr, sc, child, depth + 1)) return false;
+    } else {
+      if (!sc->skip_value()) return false;
+    }
+
+  next_member:
+    sc->skip_ws();
+    if (sc->at_end()) return false;
+    if (sc->peek() == ',') {
+      sc->p++;
+      continue;
+    }
+    if (sc->peek() == '}') {
+      sc->p++;
+      return true;
+    }
+    return false;
+  }
+}
+
+void build_trie(Parser* pr) {
+  // jsprim-pluck lookup order: at every object level the literal
+  // remaining path is checked before splitting on the first dot, so a
+  // match's priority decreases with the number of splits taken
+  // (255 = fully direct).  Higher priority overwrites lower; equal
+  // priority keeps the last JSON occurrence (JSON.parse rule).
+  for (size_t fi = 0; fi < pr->paths.size(); fi++) {
+    const std::string& path = pr->paths[fi];
+    struct Item { TrieNode* node; std::string rest; uint8_t splits; };
+    std::vector<Item> frontier;
+    frontier.push_back({&pr->root, path, 0});
+    while (!frontier.empty()) {
+      Item item = frontier.back();
+      frontier.pop_back();
+      // the full remaining path is a direct key at this level
+      TrieNode*& leaf = item.node->children[item.rest];
+      if (leaf == nullptr) leaf = new TrieNode();
+      uint8_t prio = static_cast<uint8_t>(255 - item.splits);
+      if (leaf->field < 0 || prio > leaf->prio) {
+        leaf->field = static_cast<int32_t>(fi);
+        leaf->prio = prio;
+      }
+      size_t dot = item.rest.find('.');
+      if (dot == std::string::npos) continue;
+      std::string head = item.rest.substr(0, dot);
+      std::string tail = item.rest.substr(dot + 1);
+      TrieNode*& sub = item.node->children[head];
+      if (sub == nullptr) sub = new TrieNode();
+      frontier.push_back({sub, tail,
+                          static_cast<uint8_t>(item.splits + 1)});
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dn_parser_create(const char** paths, const uint8_t* date_hints,
+                       int32_t nfields) {
+  Parser* pr = new Parser();
+  pr->fields.resize(nfields);
+  for (int32_t i = 0; i < nfields; i++) {
+    pr->paths.emplace_back(paths[i]);
+    pr->fields[i].date_hint = date_hints[i] != 0;
+  }
+  build_trie(pr);
+  return pr;
+}
+
+void dn_parser_destroy(void* h) {
+  delete static_cast<Parser*>(h);
+}
+
+// Parse a buffer of newline-separated JSON.  Appends one slot per valid
+// record to every field's output arrays.  Returns the number of records
+// appended in this call.
+int64_t dn_parser_parse(void* h, const char* buf, int64_t len) {
+  Parser* pr = static_cast<Parser*>(h);
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t appended = 0;
+
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', end - p));
+    const char* line_end = (nl != nullptr) ? nl : end;
+    pr->nlines++;
+
+    // provision a slot in every field
+    for (auto& f : pr->fields) {
+      f.tags.push_back(TAG_MISSING);
+      f.nums.push_back(0.0);
+      f.strcodes.push_back(-1);
+      if (f.date_hint) {
+        f.datesecs.push_back(0.0);
+        f.dateerr.push_back(DATE_UNDEF);
+      }
+      f.cur_prio = 0;
+    }
+
+    Scanner sc{p, line_end};
+    bool ok = parse_object(pr, &sc, &pr->root, 0);
+    if (ok) {
+      sc.skip_ws();
+      ok = sc.at_end();
+    }
+    if (!ok) {
+      // roll back the slot
+      for (auto& f : pr->fields) {
+        f.tags.pop_back();
+        f.nums.pop_back();
+        f.strcodes.pop_back();
+        if (f.date_hint) {
+          f.datesecs.pop_back();
+          f.dateerr.pop_back();
+        }
+      }
+      pr->nbad++;
+    } else {
+      pr->nrecords++;
+      pr->batch_records++;
+      appended++;
+    }
+
+    if (nl == nullptr) break;
+    p = nl + 1;
+  }
+  return appended;
+}
+
+int64_t dn_parser_nlines(void* h) {
+  return static_cast<Parser*>(h)->nlines;
+}
+int64_t dn_parser_nbad(void* h) {
+  return static_cast<Parser*>(h)->nbad;
+}
+
+int64_t dn_parser_batch_size(void* h) {
+  return static_cast<int64_t>(
+      static_cast<Parser*>(h)->batch_records);
+}
+
+const uint8_t* dn_parser_tags(void* h, int32_t field) {
+  return static_cast<Parser*>(h)->fields[field].tags.data();
+}
+const double* dn_parser_nums(void* h, int32_t field) {
+  return static_cast<Parser*>(h)->fields[field].nums.data();
+}
+const int32_t* dn_parser_strcodes(void* h, int32_t field) {
+  return static_cast<Parser*>(h)->fields[field].strcodes.data();
+}
+const double* dn_parser_datesecs(void* h, int32_t field) {
+  return static_cast<Parser*>(h)->fields[field].datesecs.data();
+}
+const uint8_t* dn_parser_dateerr(void* h, int32_t field) {
+  return static_cast<Parser*>(h)->fields[field].dateerr.data();
+}
+
+int32_t dn_parser_dict_size(void* h, int32_t field) {
+  return static_cast<int32_t>(
+      static_cast<Parser*>(h)->fields[field].dict.values.size());
+}
+const char* dn_parser_dict_get(void* h, int32_t field, int32_t code,
+                               int32_t* len) {
+  const std::string& s =
+      static_cast<Parser*>(h)->fields[field].dict.values[code];
+  *len = static_cast<int32_t>(s.size());
+  return s.data();
+}
+
+// Reset per-batch outputs (dictionaries persist across batches).
+void dn_parser_reset_batch(void* h) {
+  Parser* pr = static_cast<Parser*>(h);
+  pr->batch_records = 0;
+  for (auto& f : pr->fields) {
+    f.tags.clear();
+    f.nums.clear();
+    f.strcodes.clear();
+    f.datesecs.clear();
+    f.dateerr.clear();
+  }
+}
+
+}  // extern "C"
