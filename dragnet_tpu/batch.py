@@ -155,12 +155,16 @@ def pluck_column(records, path):
     on the first dot)."""
     UD = jsv.UNDEFINED
     if '.' not in path:
-        return [r.get(path, UD) for r in records]
+        return [r.get(path, UD) if type(r) is dict else UD
+                for r in records]
     head, tail = path.split('.', 1)
     if '.' not in tail:
         out = []
         append = out.append
         for r in records:
+            if type(r) is not dict:  # scalar top-level JSON lines
+                append(UD)
+                continue
             v = r.get(path, UD)
             if v is UD:
                 sub = r.get(head)

@@ -150,6 +150,7 @@ class DatasourceFile(object):
                     buf_r, buf_w = [], []
             scanner.write_batch(buf_r, buf_w)
         else:
+            from .engine import weights_array
             stages = mod_ingest.make_parser_stages(pipeline, fmt)
             scanner = StreamScan(query, self.ds_timefield, pipeline,
                                  ds_filter=self.ds_filter)
@@ -157,6 +158,11 @@ class DatasourceFile(object):
                 mod_ingest.iter_lines([p for p, st in files]), fmt,
                 stages=stages)
             for fields, value in records:
+                # weight coercion identical to the vectorized paths
+                # (json-skinner values may be strings/garbage)
+                if not isinstance(value, int):
+                    value = float(weights_array([value])[0])
+                    value = int(value) if value.is_integer() else value
                 scanner.write(fields, value)
 
         return ScanResult(pipeline, points=scanner.aggr.points(),
@@ -201,48 +207,15 @@ class DatasourceFile(object):
                 adapter_stage.bump('ninputs', n)
                 adapter_stage.bump('noutputs', n)
             if skinner:
-                from . import native as mod_native2
-                from . import jsvalues as jsv
                 tags, nums, strcodes = parser.columns('value')
-                weights = np.zeros(n, dtype=np.float64)
-                m = (tags == mod_native2.TAG_INT) | \
-                    (tags == mod_native2.TAG_NUMBER)
-                weights[m] = nums[m]
-                weights[tags == mod_native2.TAG_TRUE] = 1.0
-                ms = tags == mod_native2.TAG_STRING
-                if ms.any():
-                    # string weights coerce via JS Number (NaN -> 0),
-                    # matching engine.weights_array on the dict path
-                    d = parser.dictionary('value')
-                    table = np.array(
-                        [0.0 if (f := jsv.to_number(s)) != f else f
-                         for s in d], dtype=np.float64)
-                    weights[ms] = table[strcodes[ms]]
+                weights = _skinner_weights(tags, nums, strcodes, parser)
             else:
                 weights = np.ones(n, dtype=np.float64)
             src = _RemappedParser(parser, remap) if skinner else parser
             scanner.write_native_batch(src, weights)
             parser.reset_batch()
 
-        carry = b''
-        for path, st in files:
-            with open(path, 'rb') as f:
-                while True:
-                    chunk = f.read(1 << 22)
-                    if not chunk:
-                        break
-                    buf = carry + chunk
-                    nl = buf.rfind(b'\n')
-                    if nl == -1:
-                        carry = buf
-                        continue
-                    parser.parse(buf[:nl + 1])
-                    carry = buf[nl + 1:]
-                    if parser.batch_size() >= BATCH_SIZE:
-                        flush()
-        if carry:
-            parser.parse(carry)
-        flush()
+        self._stream_native(files, parser, flush, BATCH_SIZE)
         # counters even when the final batch was empty
         nlines, nbad = parser.counters()
         if nlines:
@@ -306,33 +279,46 @@ class DatasourceFile(object):
                                           interval, self.ds_timefield)
                    for m in metrics]
 
-        stages = mod_ingest.make_parser_stages(pipeline, fmt)
+        from .engine import engine_mode
+        use_vector = os.environ.get('DN_BUILD_ENGINE', 'auto') != 'host' \
+            and engine_mode() != 'host'
+        native_lib = None
+        if use_vector:
+            from . import native as mod_native
+            native_lib = mod_native.get_lib()
 
-        # The datasource filter is applied once on the shared parse stream;
-        # each metric's own filter lives in its StreamScan (reference:
-        # lib/datasource-file.js:124-192 vs :403-427).
-        ds_filter_stage = None
-        if filter is not None:
-            from . import krill as mod_krill
-            from .scan import FilterStage
-            ds_filter_stage = FilterStage(
-                mod_krill.create(filter),
-                pipeline.stage('Datasource filter'))
+        if native_lib is not None:
+            scanners = self._index_scan_native(
+                queries, files, fmt, filter, pipeline)
+        else:
+            stages = mod_ingest.make_parser_stages(pipeline, fmt)
 
-        scanners = []
-        for qi, q in enumerate(queries):
-            s = StreamScan(q, self.ds_timefield, pipeline, ds_filter=None)
-            pipeline.stage('Add __dn_metric')
-            scanners.append(s)
+            # The datasource filter is applied once on the shared parse
+            # stream; each metric's own filter lives in its StreamScan
+            # (reference: lib/datasource-file.js:124-192 vs :403-427).
+            ds_filter_stage = None
+            if filter is not None:
+                from . import krill as mod_krill
+                from .scan import FilterStage
+                ds_filter_stage = FilterStage(
+                    mod_krill.create(filter),
+                    pipeline.stage('Datasource filter'))
 
-        lines = mod_ingest.iter_lines([p for p, st in files])
-        for fields, value in mod_ingest.iter_records(lines, fmt,
-                                                     stages=stages):
-            if ds_filter_stage is not None and \
-                    not ds_filter_stage.accept(fields):
-                continue
-            for s in scanners:
-                s.write(fields, value)
+            scanners = []
+            for qi, q in enumerate(queries):
+                s = StreamScan(q, self.ds_timefield, pipeline,
+                               ds_filter=None)
+                pipeline.stage('Add __dn_metric')
+                scanners.append(s)
+
+            lines = mod_ingest.iter_lines([p for p, st in files])
+            for fields, value in mod_ingest.iter_records(lines, fmt,
+                                                         stages=stages):
+                if ds_filter_stage is not None and \
+                        not ds_filter_stage.accept(fields):
+                    continue
+                for s in scanners:
+                    s.write(fields, value)
 
         tagged = []
         for qi, s in enumerate(scanners):
@@ -345,6 +331,127 @@ class DatasourceFile(object):
 
         self._index_write(metrics, interval, tagged)
         return ScanResult(pipeline, points=None)
+
+    def _index_scan_native(self, queries, files, fmt, filter, pipeline):
+        """Build fan-out over the native parser: ONE pass over raw bytes
+        feeds every metric's vectorized scan (the reference pipes one
+        parse stream into N StreamScans, lib/datasource-file.js:403-427;
+        here one columnar provider feeds N engine passes)."""
+        from . import native as mod_native
+        from . import engine as mod_engine
+        from .engine import BATCH_SIZE, NativeColumns, VectorPredicate
+
+        stages = mod_ingest.make_parser_stages(pipeline, fmt)
+        parser_stage, adapter_stage = stages
+
+        class _Holder(object):
+            raw_columns = {}
+            filter_fields = []
+
+        ds_pred = None
+        ds_stage = None
+        if filter is not None:
+            holder = _Holder()
+            ds_pred = VectorPredicate(filter, holder)
+            ds_stage = pipeline.stage('Datasource filter')
+
+        scanners = []
+        for q in queries:
+            s = self._vector_scan_cls()(q, self.ds_timefield, pipeline,
+                                        ds_filter=None)
+            pipeline.stage('Add __dn_metric')
+            scanners.append(s)
+
+        skinner = fmt == 'json-skinner'
+        proj = {}
+        if filter is not None:
+            for f in holder.filter_fields:
+                proj.setdefault(f, False)
+        for s in scanners:
+            for p, h in s.projection():
+                proj[p] = proj.get(p, False) or h
+
+        items = list(proj.items())
+        if skinner:
+            paths = ['fields.' + p for p, h in items] + ['value']
+            hints = [h for p, h in items] + [False]
+        else:
+            paths = [p for p, h in items]
+            hints = [h for p, h in items]
+        parser = mod_native.NativeParser(paths, hints)
+        remap = {p: np_ for (p, h), np_ in zip(items, paths)} \
+            if skinner else None
+
+        from .ops.kernels import TRUE
+
+        def flush():
+            n = parser.batch_size()
+            if n == 0:
+                return
+            nlines, nbad = parser.counters()
+            parser_stage.counters['ninputs'] = nlines
+            parser_stage.counters['noutputs'] = nlines - nbad
+            if nbad:
+                parser_stage.counters['invalid json'] = nbad
+            if adapter_stage is not None:
+                adapter_stage.bump('ninputs', n)
+                adapter_stage.bump('noutputs', n)
+            src = _RemappedParser(parser, remap) if skinner else parser
+            provider = NativeColumns(src)
+            if skinner:
+                tags, nums, strcodes = parser.columns('value')
+                weights = _skinner_weights(tags, nums, strcodes, parser)
+            else:
+                weights = np.ones(n, dtype=np.float64)
+            alive0 = None
+            if ds_pred is not None:
+                ds_stage.bump('ninputs', n)
+                out = ds_pred.outcomes(provider)
+                nfail = int((out == 2).sum())
+                ndrop = int((out == 0).sum())
+                if nfail:
+                    ds_stage.bump('nfailedeval', nfail)
+                if ndrop:
+                    ds_stage.bump('nfilteredout', ndrop)
+                alive0 = out == TRUE
+                ds_stage.bump('noutputs', int(alive0.sum()))
+            for s in scanners:
+                s._process(provider, weights, alive=alive0)
+            parser.reset_batch()
+
+        self._stream_native(files, parser, flush, BATCH_SIZE)
+        nlines, nbad = parser.counters()
+        if nlines:
+            parser_stage.counters['ninputs'] = nlines
+            parser_stage.counters['noutputs'] = nlines - nbad
+            if nbad:
+                parser_stage.counters['invalid json'] = nbad
+        return scanners
+
+    def _stream_native(self, files, parser, flush, batch_size):
+        """Feed the concatenated file bytes to the native parser,
+        flushing a batch whenever enough records accumulate (partial
+        trailing lines join across file boundaries — catstreams
+        semantics)."""
+        carry = b''
+        for path, st in files:
+            with open(path, 'rb') as f:
+                while True:
+                    chunk = f.read(1 << 22)
+                    if not chunk:
+                        break
+                    buf = carry + chunk
+                    nl = buf.rfind(b'\n')
+                    if nl == -1:
+                        carry = buf
+                        continue
+                    parser.parse(buf[:nl + 1])
+                    carry = buf[nl + 1:]
+                    if parser.batch_size() >= batch_size:
+                        flush()
+        if carry:
+            parser.parse(carry)
+        flush()
 
     def _index_write(self, metrics, interval, tagged_points):
         """Write aggregated points into interval-chunked index files;
@@ -456,6 +563,25 @@ class DatasourceFile(object):
                 aggr.write(fields, value)
 
         return ScanResult(pipeline, points=aggr.points(), query=query)
+
+
+def _skinner_weights(tags, nums, strcodes, parser):
+    """json-skinner point weights with JS Number coercion (NaN -> 0),
+    matching engine.weights_array on the Python ingest path."""
+    from . import native as mod_native
+    from . import jsvalues as jsv
+    weights = np.zeros(len(tags), dtype=np.float64)
+    m = (tags == mod_native.TAG_INT) | (tags == mod_native.TAG_NUMBER)
+    weights[m] = nums[m]
+    weights[tags == mod_native.TAG_TRUE] = 1.0
+    ms = tags == mod_native.TAG_STRING
+    if ms.any():
+        d = parser.dictionary('value')
+        table = np.array(
+            [0.0 if (f := jsv.to_number(s)) != f else f for s in d],
+            dtype=np.float64)
+        weights[ms] = table[strcodes[ms]]
+    return weights
 
 
 class _RemappedParser(object):

@@ -45,6 +45,22 @@ def engine_mode():
     return os.environ.get('DN_ENGINE', 'auto')
 
 
+def _native_str_trans(column, parser_dict):
+    """Engine-dictionary codes for a native parser's per-field string
+    dictionary, cached on the engine column and extended incrementally
+    (both dictionaries are append-only)."""
+    cache = getattr(column, '_native_trans', None)
+    if cache is None:
+        cache = np.zeros(0, dtype=np.int64)
+    if len(cache) < len(parser_dict):
+        code = column.dict.code
+        new = np.array([code(s, s) for s in parser_dict[len(cache):]],
+                       dtype=np.int64)
+        cache = np.concatenate([cache, new])
+        column._native_trans = cache
+    return cache
+
+
 def weights_array(values):
     """Point weights -> f64 with JS Number coercion (json-skinner values
     may be strings or garbage; NaN becomes 0 rather than poisoning
@@ -95,13 +111,14 @@ class DictColumns(object):
 
 
 class NativeColumns(object):
-    """Columns adapted from the C++ parser's tagged arrays."""
+    """Columns adapted from the C++ parser's tagged arrays.  Scan-
+    independent, so one provider instance can feed several metric scans
+    in a single pass (the build fan-out)."""
 
-    def __init__(self, parser, scan):
+    def __init__(self, parser):
         from . import native as mod_native
         self.mn = mod_native
         self.parser = parser
-        self.scan = scan
         self.n = parser.batch_size()
         self._cols = {}
         self._dates = {}
@@ -147,20 +164,22 @@ class NativeColumns(object):
     def _array_values(self, path):
         """(dict_code, parsed_value) for array-tagged entries of this
         field's dictionary (raw JSON text interned by the parser).
-        Cached on the scan keyed by dictionary length (the dictionary is
-        append-only), like _native_str_trans.  The raw text passed the
-        parser's strict JSON validation, so json.loads cannot fail here
-        — a failure would mean native/fallback divergence and should be
-        loud."""
+        Cached on the parser keyed by dictionary length (the dictionary
+        is append-only).  The raw text passed the parser's strict JSON
+        validation, so json.loads cannot fail here — a failure would
+        mean native/fallback divergence and should be loud."""
         import json
         d = self.parser.dictionary(path)
-        key = ('arrays', path)
-        cached = self.scan._str_trans.get(key)
+        cache = getattr(self.parser, '_array_cache', None)
+        if cache is None:
+            cache = {}
+            self.parser._array_cache = cache
+        cached = cache.get(path)
         if cached is None or cached[0] < len(d):
             out = [(i, json.loads(raw)) for i, raw in enumerate(d)
                    if raw.startswith('[')]
             cached = (len(d), out)
-            self.scan._str_trans[key] = cached
+            cache[path] = cached
         return cached[1]
 
     def string_codes(self, path, column):
@@ -195,7 +214,7 @@ class NativeColumns(object):
         m = tags == mn.TAG_STRING
         if m.any():
             d = self.parser.dictionary(path)
-            trans = self.scan._native_str_trans(path, column, d)
+            trans = _native_str_trans(column, d)
             out[m] = trans[strcodes[m]]
         return out
 
@@ -315,7 +334,6 @@ class VectorScan(object):
         self.filter_fields = []
         self.string_columns = {}
         self._dict_code_cache = {}
-        self._str_trans = {}
 
         self.ds_pred = self.user_pred = None
         if ds_filter is not None:
@@ -370,16 +388,6 @@ class VectorScan(object):
             self._dict_code_cache[cache_key] = codes
         return codes
 
-    def _native_str_trans(self, path, column, parser_dict):
-        """Engine-dictionary codes for the native parser's per-field
-        string dictionary (incrementally extended)."""
-        trans = self._str_trans.get(path)
-        if trans is None or len(trans) < len(parser_dict):
-            code = column.dict.code
-            trans = np.array([code(s, s) for s in parser_dict],
-                             dtype=np.int64)
-            self._str_trans[path] = trans
-        return trans
 
     # -- per-batch execution ----------------------------------------------
 
@@ -393,12 +401,13 @@ class VectorScan(object):
     def write_native_batch(self, parser, weights):
         if parser.batch_size() == 0:
             return
-        provider = NativeColumns(parser, self)
+        provider = NativeColumns(parser)
         self._process(provider, np.asarray(weights, dtype=np.float64))
 
-    def _process(self, provider, weights):
+    def _process(self, provider, weights, alive=None):
         n = provider.n
-        alive = np.ones(n, dtype=bool)
+        alive = np.ones(n, dtype=bool) if alive is None \
+            else alive.copy()
 
         for pred, stage in ((self.ds_pred,
                              getattr(self, 'ds_stage', None)),

@@ -36,16 +36,25 @@ _SO_PATH = os.path.join(_NATIVE_DIR, 'build', 'libdnparse.so')
 def _build():
     src = os.path.join(_NATIVE_DIR, 'dnparse.cc')
     if not os.path.exists(src):
-        return False
+        return os.path.exists(_SO_PATH)
     if os.path.exists(_SO_PATH) and \
             os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
         return True
     try:
-        subprocess.run(['make', '-C', _NATIVE_DIR],
-                       check=True, stdout=subprocess.DEVNULL,
-                       stderr=subprocess.DEVNULL)
+        # serialize concurrent builds (multi-process cluster launches)
+        import fcntl
+        os.makedirs(os.path.join(_NATIVE_DIR, 'build'), exist_ok=True)
+        lockpath = os.path.join(_NATIVE_DIR, 'build', '.lock')
+        with open(lockpath, 'w') as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not (os.path.exists(_SO_PATH) and os.path.getmtime(
+                    _SO_PATH) >= os.path.getmtime(src)):
+                subprocess.run(['make', '-C', _NATIVE_DIR],
+                               check=True, stdout=subprocess.DEVNULL,
+                               stderr=subprocess.DEVNULL)
     except Exception:
-        return False
+        # a stale-but-loadable library beats the 9x-slower fallback
+        return os.path.exists(_SO_PATH)
     return os.path.exists(_SO_PATH)
 
 
@@ -149,8 +158,13 @@ class NativeParser(object):
             ln = ctypes.c_int32()
             p = self.lib.dn_parser_dict_get(self.h, fi, len(d),
                                             ctypes.byref(ln))
-            d.append(ctypes.string_at(p, ln.value).decode(
-                'utf-8', 'surrogateescape'))
+            raw = ctypes.string_at(p, ln.value)
+            try:
+                # surrogatepass round-trips lone \uD800-class escapes
+                # exactly like json.loads does
+                d.append(raw.decode('utf-8', 'surrogatepass'))
+            except UnicodeDecodeError:
+                d.append(raw.decode('utf-8', 'surrogateescape'))
         return d
 
     def _np(self, fn, field, dtype, n):

@@ -54,6 +54,34 @@ class DatasourceCluster(datasource_file.DatasourceFile):
     def _vector_scan_cls(self):
         return MeshVectorScan
 
+    def build(self, metrics, interval, time_after=None, time_before=None,
+              dry_run=False, warn_func=None):
+        """Distributed index build: every process index-scans its file
+        partition (map), the tagged partial aggregates merge across
+        processes (reduce), and process 0 writes the index artifacts —
+        the same phase structure as the reference's Manta build
+        (lib/datasource-manta.js:265-384) without job orchestration."""
+        nprocs, pid = mod_dist.maybe_initialize()
+        if nprocs <= 1 or dry_run:
+            return super(DatasourceCluster, self).build(
+                metrics, interval, time_after=time_after,
+                time_before=time_before, dry_run=dry_run,
+                warn_func=warn_func)
+
+        result = self.index_scan(metrics, interval,
+                                 filter=self.ds_filter,
+                                 time_after=time_after,
+                                 time_before=time_before)
+        merged = _allgather_merge_tagged(result.points)
+        if pid == 0:
+            self._index_write(metrics, interval, merged)
+        from ..ops import get_jax
+        jax, _ = get_jax()
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices('dn_build_done')
+        result.points = None
+        return result
+
     def scan(self, query, dry_run=False, warn_func=None):
         """Local scan over this process's file partition, then a
         points-level cross-process merge (process_allgather of the
@@ -68,6 +96,38 @@ class DatasourceCluster(datasource_file.DatasourceFile):
             return result
         result.points = _allgather_merge_points(query, result.points)
         return result
+
+
+def _allgather_merge_tagged(points):
+    """Cross-process merge of __dn_metric-tagged aggregated points (the
+    index-build reduce): identical (metric, fields) tuples sum their
+    weights — already bucket-min encoded, so plain addition is exact."""
+    from ..ops import get_jax
+    from .. import jsvalues as jsv
+    import json
+    jax, _ = get_jax()
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps([[f, v] for f, v in points]).encode()
+    data = np.frombuffer(payload, dtype=np.uint8)
+    lens = multihost_utils.process_allgather(
+        np.array([data.shape[0]], dtype=np.int64))
+    maxlen = int(np.max(lens))
+    padded = np.zeros(maxlen, dtype=np.uint8)
+    padded[:data.shape[0]] = data
+    gathered = multihost_utils.process_allgather(padded)
+
+    merged = {}
+    order = []
+    for i in range(gathered.shape[0]):
+        raw = bytes(gathered[i][:int(lens[i][0])])
+        for fields, value in json.loads(raw.decode()):
+            key = jsv.json_stringify(fields)
+            if key not in merged:
+                merged[key] = [fields, 0]
+                order.append(key)
+            merged[key][1] += value
+    return [(merged[k][0], merged[k][1]) for k in order]
 
 
 def _allgather_merge_points(query, points):

@@ -102,6 +102,10 @@ def sharded_aggregate(key_codes, radices, weights, alive, scatter=False):
     # (same guard as the single-device jax path in engine.py).
     int_w = bool(np.all(weights == np.floor(weights)))
     if not (int_w and float(np.abs(weights).sum()) < 2 ** 31):
+        # exact-f64 host merge; cannot honor the per-device-slice
+        # contract of the scatter variant
+        assert not scatter, \
+            'scatter=True requires int32-safe weights'
         fused = np.zeros(n, dtype=np.int64)
         for i in range(len(radices)):
             fused = fused * int(radices[i]) + key_codes[i]

@@ -84,6 +84,10 @@ struct TrieNode {
   // field index terminated by this key at this level, with priority
   int32_t field = -1;
   uint8_t prio = 0;
+  // every (field, priority) reachable at-or-below this node: used to
+  // honor JSON.parse last-occurrence-wins when a later duplicate key
+  // replaces a whole subtree (earlier captures must be cleared)
+  std::vector<std::pair<int32_t, uint8_t>> subtree_fields;
   ~TrieNode() {
     for (auto& kv : children) delete kv.second;
   }
@@ -459,6 +463,28 @@ bool parse_object(Parser* pr, Scanner* sc, TrieNode* node, int depth) {
       if (it != node->children.end()) child = it->second;
     }
 
+    if (child != nullptr) {
+      // JSON.parse keeps the LAST occurrence of a duplicate key: any
+      // field previously captured through this key's subtree (at the
+      // priority this subtree grants) must be cleared before the new
+      // value is considered — even if the new value is a non-object
+      // that provides nothing.
+      for (const auto& fp : child->subtree_fields) {
+        FieldOut& f = pr->fields[fp.first];
+        if (f.cur_prio != 0 && f.cur_prio <= fp.second) {
+          size_t i = f.tags.size() - 1;
+          f.cur_prio = 0;
+          f.tags[i] = TAG_MISSING;
+          f.nums[i] = 0.0;
+          f.strcodes[i] = -1;
+          if (f.date_hint) {
+            f.datesecs[i] = 0.0;
+            f.dateerr[i] = DATE_UNDEF;
+          }
+        }
+      }
+    }
+
     if (child != nullptr && child->field >= 0) {
       FieldOut& f = pr->fields[child->field];
       // direct-key-first: a higher-priority match overwrites a lower
@@ -549,6 +575,8 @@ bool parse_object(Parser* pr, Scanner* sc, TrieNode* node, int depth) {
   }
 }
 
+void fill_subtree_fields(TrieNode* node);
+
 void build_trie(Parser* pr) {
   // jsprim-pluck lookup order: at every object level the literal
   // remaining path is checked before splitting on the first dot, so a
@@ -579,6 +607,19 @@ void build_trie(Parser* pr) {
       if (sub == nullptr) sub = new TrieNode();
       frontier.push_back({sub, tail,
                           static_cast<uint8_t>(item.splits + 1)});
+    }
+  }
+  fill_subtree_fields(&pr->root);
+}
+
+void fill_subtree_fields(TrieNode* node) {
+  if (node->field >= 0) {
+    node->subtree_fields.emplace_back(node->field, node->prio);
+  }
+  for (auto& kv : node->children) {
+    fill_subtree_fields(kv.second);
+    for (const auto& fp : kv.second->subtree_fields) {
+      node->subtree_fields.push_back(fp);
     }
   }
 }
@@ -631,7 +672,15 @@ int64_t dn_parser_parse(void* h, const char* buf, int64_t len) {
     }
 
     Scanner sc{p, line_end};
-    bool ok = parse_object(pr, &sc, &pr->root, 0);
+    sc.skip_ws();
+    bool ok;
+    if (!sc.at_end() && sc.peek() == '{') {
+      ok = parse_object(pr, &sc, &pr->root, 0);
+    } else {
+      // any valid JSON value is a record (JSON.parse-per-line
+      // semantics); projected fields simply stay missing
+      ok = !sc.at_end() && sc.skip_value();
+    }
     if (ok) {
       sc.skip_ws();
       ok = sc.at_end();

@@ -1,0 +1,35 @@
+"""Worker for the multi-process cluster test: scans a dataset through
+the cluster datasource under jax.distributed and prints the points."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+def main():
+    datadir = sys.argv[1]
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+
+    from dragnet_tpu import query as mod_query
+    from dragnet_tpu.parallel import cluster, distributed
+
+    nprocs, pid = distributed.maybe_initialize()
+    ds = cluster.DatasourceCluster({
+        'ds_backend': 'cluster',
+        'ds_backend_config': {'path': datadir},
+        'ds_filter': None,
+        'ds_format': 'json',
+    })
+    q = mod_query.query_load({'breakdowns': [
+        {'name': 'host'}, {'name': 'latency', 'aggr': 'quantize'}]})
+    result = ds.scan(q)
+    print(json.dumps({'pid': pid, 'nprocs': nprocs,
+                      'points': result.points}))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,87 @@
+"""The distributed-build protocol seam: `dn index-scan` emits tagged
+aggregated points, `dn index-read` turns a point stream back into index
+files, and the result must answer queries identically to a direct
+`dn build` — the single-process composition the reference's Manta tests
+asserted with a real object store (lib/datasource-manta.js:63-78)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from parity.runner import DnRunner, DATADIR, have_reference  # noqa: E402
+
+pytestmark = pytest.mark.skipif(not have_reference(),
+                                reason='reference data not available')
+
+
+def test_index_scan_read_equals_build(tmp_path):
+    r = DnRunner(tmp_path)
+    idx_direct = str(tmp_path / 'idx_direct')
+    idx_via = str(tmp_path / 'idx_via')
+
+    r.clear_config()
+    r.dn('datasource-add', 'direct', '--path=' + DATADIR,
+         '--index-path=' + idx_direct, '--time-field=time')
+    r.dn('metric-add', 'direct', 'met', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=86400],'
+         'req.method,latency[aggr=quantize]')
+    r.dn('build', 'direct')
+
+    r.dn('datasource-add', 'via', '--path=' + DATADIR,
+         '--index-path=' + idx_via, '--time-field=time')
+    r.dn('metric-add', 'via', 'met', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=86400],'
+         'req.method,latency[aggr=quantize]')
+
+    # map phase: emit tagged aggregated points
+    points, err, rc = r.run(['index-scan', 'via'])
+    assert rc == 0 and points.count('\n') > 0
+    assert '__dn_metric' in points and '__dn_ts' in points
+
+    # reduce phase: rebuild index files from the point stream
+    out, err, rc = r.run(['index-read', 'via'], stdin=points)
+    assert rc == 0, err
+
+    assert sorted(os.listdir(os.path.join(idx_via, 'by_day'))) == \
+        sorted(os.listdir(os.path.join(idx_direct, 'by_day')))
+
+    for args in (['query', 'via'],
+                 ['query', 'via', '-b', 'req.method'],
+                 ['query', 'via', '-b', 'latency[aggr=quantize]'],
+                 ['query', '--after', '2014-05-02', '--before',
+                  '2014-05-04', 'via']):
+        got, _, _ = r.run(args)
+        want, _, _ = r.run([a if a != 'via' else 'direct' for a in args])
+        assert got == want, args
+
+
+def test_index_config_roundtrip(tmp_path):
+    """--index-config overrides configured metrics (the mechanism the
+    distributed build uses to ship metric definitions to workers)."""
+    r = DnRunner(tmp_path)
+    idx = str(tmp_path / 'idx')
+    r.clear_config()
+    r.dn('datasource-add', 'input', '--path=' + DATADIR,
+         '--index-path=' + idx, '--time-field=time')
+    r.dn('metric-add', 'input', 'met', '-b', 'req.method')
+    cfg, _, _ = r.run(['index-config', 'input'])
+    assert '"metrics"' in cfg and 'req.method' in cfg
+
+    cfgfile = tmp_path / 'indexconfig.json'
+    cfgfile.write_text(cfg)
+    r.dn('metric-remove', 'input', 'met')
+    # no configured metrics left: build must fail without the config file
+    out, err, rc = r.run(['build', '--interval=all', 'input'],
+                         check=False)
+    assert rc != 0 and 'no metrics defined' in err
+    # ...and succeed with it
+    out, err, rc = r.run(['build', '--interval=all',
+                          '--index-config=' + str(cfgfile), 'input'])
+    assert rc == 0
+    got, _, _ = r.run(['query', '--interval=all', '-b', 'req.method',
+                       'input'])
+    assert 'GET' in got

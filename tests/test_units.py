@@ -1,0 +1,144 @@
+"""Unit tests for the pure components, with case tables covering the
+same edge cases as the reference's tests/lib suite (month-length
+arithmetic, pattern alignment, attr grammar incl. malformed inputs)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu.attrs import attrs_parse                    # noqa: E402
+from dragnet_tpu.errors import DNError                       # noqa: E402
+from dragnet_tpu import find as mod_find                     # noqa: E402
+from dragnet_tpu import jsvalues as jsv                      # noqa: E402
+
+
+def enum(pattern, start, end):
+    pe = mod_find.create_path_enumerator(
+        pattern, jsv.date_parse(start), jsv.date_parse(end))
+    if isinstance(pe, DNError):
+        return pe
+    return pe.paths()
+
+
+PATHENUM_CASES = [
+    # errors
+    ('my_pattern%', ('2010-01-01T00:00:00Z', '2010-01-10T00:00:00Z'),
+     DNError('unexpected "%" at char 11')),
+    ('my_pattern%T', ('2010-01-01T00:00:00Z', '2010-01-10T00:00:00Z'),
+     DNError('unsupported conversion "%T" at char 11')),
+    # no expansion
+    ('my_pattern', ('2010-01-01T00:00:00Z', '2010-01-10T00:00:00Z'),
+     ['my_pattern']),
+    ('my_%%pattern', ('2010-01-01T00:00:00Z', '2010-01-10T00:00:00Z'),
+     ['my_%pattern']),
+    ('my_pattern%%', ('2010-01-01T00:00:00Z', '2010-01-10T00:00:00Z'),
+     ['my_pattern%']),
+    # year
+    ('%Y', ('2010-12-03T01:23:45.678Z', '2013-01-01T00:00:00.000'),
+     ['2010', '2011', '2012']),
+    ('%Y', ('2010-01-01T00:00:00.000Z', '2013-01-01T00:00:00.001'),
+     ['2010', '2011', '2012', '2013']),
+    ('%Y', ('2014-02-01T00:00:00.000Z', '2014-02-01T00:00:00.000Z'),
+     ['2014']),
+    ('%Y', ('2014-12-31T23:59:59.999Z', '2015-01-01T00:00:00.001Z'),
+     ['2014', '2015']),
+    # month (tricky: month-length arithmetic)
+    ('%Y-%m', ('2010-06-01T00:00:00Z', '2012-08-01T00:00:00Z'),
+     ['2010-%02d' % m for m in range(6, 13)] +
+     ['2011-%02d' % m for m in range(1, 13)] +
+     ['2012-%02d' % m for m in range(1, 8)]),
+    ('%Y-%m', ('2010-10-30T00:00:00Z', '2011-05-01T00:00:00Z'),
+     ['2010-10', '2010-11', '2010-12', '2011-01', '2011-02', '2011-03',
+      '2011-04']),
+    ('%Y/%m', ('2014-02-01T00:00:00.000Z', '2014-02-01T00:00:00.000Z'),
+     ['2014/02']),
+    ('%Y/%m', ('2014-01-31T23:59:59.999Z', '2014-02-01T00:00:00.001Z'),
+     ['2014/01', '2014/02']),
+    # day
+    ('%d', ('2010-06-12T03:05:06Z', '2010-06-18T00:00:00Z'),
+     ['12', '13', '14', '15', '16', '17']),
+    ('year_%Y/month_%m/day_%d/some/other/stuff',
+     ('2014-02-26', '2014-03-03'),
+     ['year_2014/month_02/day_26/some/other/stuff',
+      'year_2014/month_02/day_27/some/other/stuff',
+      'year_2014/month_02/day_28/some/other/stuff',
+      'year_2014/month_03/day_01/some/other/stuff',
+      'year_2014/month_03/day_02/some/other/stuff']),
+    ('%m/%d', ('2014-01-31T23:59:59.999Z', '2014-02-01T00:00:00.001Z'),
+     ['01/31', '02/01']),
+    # hour
+    ('%H', ('2010-06-12T03:05:06Z', '2010-06-12T09:00:00Z'),
+     ['03', '04', '05', '06', '07', '08']),
+    ('%Y/%m/%d/%H', ('2014-02-28T20:00:00Z', '2014-03-01T04:00:00Z'),
+     ['2014/02/28/%02d' % h for h in range(20, 24)] +
+     ['2014/03/01/%02d' % h for h in range(0, 4)]),
+    ('%d/%H', ('2014-01-31T23:59:59.999Z', '2014-02-01T00:00:00.001Z'),
+     ['31/23', '01/00']),
+]
+
+
+def test_path_enum_table():
+    for pattern, (start, end), expected in PATHENUM_CASES:
+        got = enum(pattern, start, end)
+        if isinstance(expected, DNError):
+            assert isinstance(got, DNError), (pattern, got)
+            assert got.message == expected.message, (pattern, got.message)
+        else:
+            assert got == expected, (pattern, got)
+
+
+def test_path_enum_invalid_dates():
+    assert mod_find.create_path_enumerator('%Y', None, 123).message == \
+        '"timeStart" is not a valid date'
+    assert mod_find.create_path_enumerator('%Y', 123, None).message == \
+        '"timeEnd" is not a valid date'
+    assert mod_find.create_path_enumerator('%Y', 5, 4).message == \
+        '"timeStart" may not be after "timeEnd"'
+
+
+ATTRS_CASES = [
+    ('foo', [{'name': 'foo'}]),
+    ('foo,bar', [{'name': 'foo'}, {'name': 'bar'}]),
+    ('foo[b]', [{'name': 'foo', 'b': ''}]),
+    ('foo[myprop=one]', [{'name': 'foo', 'myprop': 'one'}]),
+    ('foo[myprop=one],bar',
+     [{'name': 'foo', 'myprop': 'one'}, {'name': 'bar'}]),
+    ('foo[p1=one,p2,p3=three],bar',
+     [{'name': 'foo', 'p1': 'one', 'p2': '', 'p3': 'three'},
+      {'name': 'bar'}]),
+    (',foo[p1=one,p2,p3=three],bar',
+     [{'name': 'foo', 'p1': 'one', 'p2': '', 'p3': 'three'},
+      {'name': 'bar'}]),
+    ('foo[p1=one,p2,p3=three],bar,',
+     [{'name': 'foo', 'p1': 'one', 'p2': '', 'p3': 'three'},
+      {'name': 'bar'}]),
+    ('foo[p1=one,p2,,p3=three],,bar',
+     [{'name': 'foo', 'p1': 'one', 'p2': '', 'p3': 'three'},
+      {'name': 'bar'}]),
+    ('foo[p1=one,p2,p3=three],bar[]',
+     [{'name': 'foo', 'p1': 'one', 'p2': '', 'p3': 'three'},
+      {'name': 'bar'}]),
+    ('foo[p1=one,p2,p3=three],bar[,p4]',
+     [{'name': 'foo', 'p1': 'one', 'p2': '', 'p3': 'three'},
+      {'name': 'bar', 'p4': ''}]),
+    ('foo[p1=one,p2,p3=three],bar[,p4=]',
+     [{'name': 'foo', 'p1': 'one', 'p2': '', 'p3': 'three'},
+      {'name': 'bar', 'p4': ''}]),
+]
+
+ATTRS_ERROR_CASES = [
+    ('foo[=bar]', 'missing attribute name'),
+    ('[]', 'missing field name'),
+    ('foo[', 'unexpected end of string'),
+]
+
+
+def test_attrs_table():
+    for s, expected in ATTRS_CASES:
+        got = attrs_parse(s)
+        assert got == expected, (s, got)
+    for s, msg in ATTRS_ERROR_CASES:
+        got = attrs_parse(s)
+        assert isinstance(got, DNError) and got.message == msg, (s, got)
