@@ -193,6 +193,9 @@ class DatasourceFile(object):
         parser = mod_native.NativeParser(paths, hints)
         remap = {p: np_ for p, np_ in
                  zip([p for p, h in proj], paths)} if skinner else None
+        # one provider for the whole scan so per-column caches
+        # (decoded array values etc.) persist across batches
+        src = _RemappedParser(parser, remap) if skinner else parser
 
         def flush():
             n = parser.batch_size()
@@ -211,7 +214,6 @@ class DatasourceFile(object):
                 weights = _skinner_weights(tags, nums, strcodes, parser)
             else:
                 weights = np.ones(n, dtype=np.float64)
-            src = _RemappedParser(parser, remap) if skinner else parser
             scanner.write_native_batch(src, weights)
             parser.reset_batch()
 
@@ -248,10 +250,10 @@ class DatasourceFile(object):
             dry_run, sink='index', warn_func=warn_func)
 
     def index_scan(self, metrics, interval, filter=None, time_after=None,
-                   time_before=None):
+                   time_before=None, warn_func=None):
         return self._index_scan_impl(
             metrics, interval, filter, time_after, time_before, False,
-            sink='points')
+            sink='points', warn_func=warn_func)
 
     def _index_scan_impl(self, metrics, interval, filter, time_after,
                          time_before, dry_run, sink, warn_func=None):
@@ -279,8 +281,11 @@ class DatasourceFile(object):
                                           interval, self.ds_timefield)
                    for m in metrics]
 
+        # --warnings needs the per-record host path for ordered
+        # warning output (same rule as scan())
         from .engine import engine_mode
-        use_vector = os.environ.get('DN_BUILD_ENGINE', 'auto') != 'host' \
+        use_vector = warn_func is None \
+            and os.environ.get('DN_BUILD_ENGINE', 'auto') != 'host' \
             and engine_mode() != 'host'
         native_lib = None
         if use_vector:
@@ -381,6 +386,8 @@ class DatasourceFile(object):
         parser = mod_native.NativeParser(paths, hints)
         remap = {p: np_ for (p, h), np_ in zip(items, paths)} \
             if skinner else None
+        # one provider object per build so per-column caches persist
+        src = _RemappedParser(parser, remap) if skinner else parser
 
         from .ops.kernels import TRUE
 
@@ -396,7 +403,6 @@ class DatasourceFile(object):
             if adapter_stage is not None:
                 adapter_stage.bump('ninputs', n)
                 adapter_stage.bump('noutputs', n)
-            src = _RemappedParser(parser, remap) if skinner else parser
             provider = NativeColumns(src)
             if skinner:
                 tags, nums, strcodes = parser.columns('value')
@@ -433,11 +439,15 @@ class DatasourceFile(object):
         flushing a batch whenever enough records accumulate (partial
         trailing lines join across file boundaries — catstreams
         semantics)."""
+        # larger reads amortize the multithreaded parse's fork/join; the
+        # cap bounds how far a batch can overshoot the flush threshold
+        # (flush is only checked between reads)
+        readsz = min(1 << 24, (1 << 22) * getattr(parser, 'nthreads', 1))
         carry = b''
         for path, st in files:
             with open(path, 'rb') as f:
                 while True:
-                    chunk = f.read(1 << 22)
+                    chunk = f.read(readsz)
                     if not chunk:
                         break
                     buf = carry + chunk

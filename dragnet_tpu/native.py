@@ -53,8 +53,16 @@ def _build():
                                check=True, stdout=subprocess.DEVNULL,
                                stderr=subprocess.DEVNULL)
     except Exception:
-        # a stale-but-loadable library beats the 9x-slower fallback
-        return os.path.exists(_SO_PATH)
+        # a stale-but-loadable library beats the 9x-slower fallback,
+        # but its semantics may lag the source — say so
+        if os.path.exists(_SO_PATH):
+            import sys
+            sys.stderr.write(
+                'dn: warning: native parser rebuild failed; using '
+                'stale %s (set DN_NATIVE=0 to force the Python '
+                'path)\n' % _SO_PATH)
+            return True
+        return False
     return os.path.exists(_SO_PATH)
 
 
@@ -84,6 +92,13 @@ def get_lib():
         lib.dn_parser_parse.restype = ctypes.c_int64
         lib.dn_parser_parse.argtypes = [ctypes.c_void_p,
                                         ctypes.c_char_p, ctypes.c_int64]
+        try:
+            lib.dn_parser_parse_mt.restype = ctypes.c_int64
+            lib.dn_parser_parse_mt.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int32]
+        except AttributeError:
+            pass
         for name in ('dn_parser_nlines', 'dn_parser_nbad',
                      'dn_parser_batch_size'):
             fn = getattr(lib, name)
@@ -114,12 +129,27 @@ def get_lib():
         return lib
 
 
+def parse_threads():
+    """Worker threads for the native parser: DN_PARSE_THREADS, else the
+    machine's core count (capped; 1 disables threading)."""
+    v = os.environ.get('DN_PARSE_THREADS', 'auto')
+    if v != 'auto':
+        try:
+            return max(1, int(v))
+        except ValueError:
+            return 1
+    return min(16, os.cpu_count() or 1)
+
+
 class NativeParser(object):
     """One parser per scan: dictionaries persist across batches."""
 
     def __init__(self, paths, date_hints):
         self.lib = get_lib()
         assert self.lib is not None
+        self.nthreads = parse_threads()
+        if not hasattr(self.lib, 'dn_parser_parse_mt'):
+            self.nthreads = 1
         self.paths = list(paths)
         arr = (ctypes.c_char_p * len(paths))(
             *[p.encode() for p in paths])
@@ -140,6 +170,9 @@ class NativeParser(object):
     def parse(self, buf):
         """Parse a bytes buffer of complete lines; returns the number of
         records appended to the current batch."""
+        if self.nthreads > 1:
+            return self.lib.dn_parser_parse_mt(self.h, buf, len(buf),
+                                               self.nthreads)
         return self.lib.dn_parser_parse(self.h, buf, len(buf))
 
     def counters(self):

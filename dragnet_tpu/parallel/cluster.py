@@ -68,17 +68,36 @@ class DatasourceCluster(datasource_file.DatasourceFile):
                 time_before=time_before, dry_run=dry_run,
                 warn_func=warn_func)
 
+        # same argument validation as the single-process build; failing
+        # here (on every process) beats a TypeError on process 0 and a
+        # barrier hang on the rest
+        error = self.check_time_args(time_after, time_before)
+        if error is None:
+            error = self.check_index_args(interval, True, True)
+        if error is not None:
+            raise error
+
         result = self.index_scan(metrics, interval,
                                  filter=self.ds_filter,
                                  time_after=time_after,
-                                 time_before=time_before)
+                                 time_before=time_before,
+                                 warn_func=warn_func)
         merged = _allgather_merge_tagged(result.points)
+        # the barrier must be reached even if the write fails, or every
+        # other process hangs in sync_global_devices until the
+        # distributed-runtime heartbeat timeout
+        write_err = None
         if pid == 0:
-            self._index_write(metrics, interval, merged)
+            try:
+                self._index_write(metrics, interval, merged)
+            except Exception as e:
+                write_err = e
         from ..ops import get_jax
         jax, _ = get_jax()
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices('dn_build_done')
+        if write_err is not None:
+            raise write_err
         result.points = None
         return result
 

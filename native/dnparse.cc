@@ -25,6 +25,7 @@
 #include <cstring>
 #include <cmath>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -78,9 +79,11 @@ struct FieldOut {
 };
 
 // projection trie node: at each object depth, a key either terminates a
-// field (direct or final segment) or descends.
+// field (direct or final segment) or descends.  Children are a small
+// linear-scan vector: record keys are matched by raw byte span with no
+// hashing or allocation (projected key sets are tiny).
 struct TrieNode {
-  std::unordered_map<std::string, TrieNode*> children;
+  std::vector<std::pair<std::string, TrieNode*>> children;
   // field index terminated by this key at this level, with priority
   int32_t field = -1;
   uint8_t prio = 0;
@@ -88,6 +91,23 @@ struct TrieNode {
   // honor JSON.parse last-occurrence-wins when a later duplicate key
   // replaces a whole subtree (earlier captures must be cleared)
   std::vector<std::pair<int32_t, uint8_t>> subtree_fields;
+
+  TrieNode* find(const char* k, size_t len) const {
+    for (const auto& kv : children) {
+      if (kv.first.size() == len &&
+          memcmp(kv.first.data(), k, len) == 0) {
+        return kv.second;
+      }
+    }
+    return nullptr;
+  }
+  TrieNode* find_or_add(const std::string& k) {
+    TrieNode* n = find(k.data(), k.size());
+    if (n != nullptr) return n;
+    n = new TrieNode();
+    children.emplace_back(k, n);
+    return n;
+  }
   ~TrieNode() {
     for (auto& kv : children) delete kv.second;
   }
@@ -97,11 +117,23 @@ struct Parser {
   std::vector<std::string> paths;
   std::vector<FieldOut> fields;
   TrieNode root;
+  // shared read-only projection trie (workers point at the main
+  // parser's root; the owner points at its own)
+  const TrieNode* trie = nullptr;
   uint64_t nlines = 0;
   uint64_t nbad = 0;
   uint64_t nrecords = 0;
   uint64_t batch_records = 0;
   std::string err;
+  // worker pool for multithreaded parse (owner only)
+  std::vector<Parser*> workers;
+  // persistent worker-code -> owner-code dictionary remaps,
+  // [worker][field][worker_code]
+  std::vector<std::vector<std::vector<int32_t>>> remaps;
+
+  ~Parser() {
+    for (Parser* w : workers) delete w;
+  }
 };
 
 // ---------------------------------------------------------------------
@@ -262,6 +294,31 @@ struct Scanner {
     return false;
   }
 
+  // Scan a JSON string assuming *p == '"'.  Fast path: no escapes and
+  // no raw control chars -> returns the raw byte span (still valid
+  // UTF-8 text, since JSON strings without escapes are literal).  If an
+  // escape is present, falls back to full decode into *decoded and sets
+  // *span_len = SIZE_MAX.  Returns false on invalid string syntax.
+  bool read_string_span(const char** span, size_t* span_len,
+                        std::string* decoded) {
+    const char* q = p + 1;
+    while (q < end) {
+      unsigned char c = static_cast<unsigned char>(*q);
+      if (c == '"') {
+        *span = p + 1;
+        *span_len = static_cast<size_t>(q - (p + 1));
+        p = q + 1;
+        return true;
+      }
+      if (c == '\\' || c < 0x20) break;
+      q++;
+    }
+    if (q >= end) return false;
+    if (static_cast<unsigned char>(*q) < 0x20 && *q != '\\') return false;
+    *span_len = static_cast<size_t>(-1);
+    return read_string(decoded);
+  }
+
   // decode a JSON string into out (UTF-8); assumes *p == '"'
   bool read_string(std::string* out) {
     p++;
@@ -406,12 +463,20 @@ struct Scanner {
     // strict JSON number grammar: -?(0|[1-9][0-9]*)(\.[0-9]+)?
     // ([eE][+-]?[0-9]+)?  (no leading zeros, no bare "1.")
     const char* start = p;
-    if (p < end && (*p == '-')) p++;
+    bool neg = false;
+    if (p < end && (*p == '-')) { neg = true; p++; }
     if (p >= end || *p < '0' || *p > '9') return false;
+    uint64_t mant = 0;
+    int ndigits = 0;
     if (*p == '0') {
       p++;
+      ndigits = 1;
     } else {
-      while (p < end && *p >= '0' && *p <= '9') p++;
+      while (p < end && *p >= '0' && *p <= '9') {
+        if (ndigits < 19) mant = mant * 10 + (*p - '0');
+        ndigits++;
+        p++;
+      }
     }
     bool integral = true;
     if (p < end && *p == '.') {
@@ -428,18 +493,35 @@ struct Scanner {
       while (p < end && *p >= '0' && *p <= '9') p++;
     }
     if (out != nullptr) {
-      std::string tmp(start, p - start);
-      *out = strtod(tmp.c_str(), nullptr);
-      double v = *out;
-      *is_int = integral && std::fabs(v) <= 9007199254740992.0 &&
-                v == std::floor(v);
+      if (integral && ndigits <= 18) {
+        // <= 18 digits fits uint64 exactly; uint64 -> double rounds to
+        // nearest, matching strtod's correctly-rounded result
+        double v = static_cast<double>(mant);
+        *out = neg ? -v : v;
+        *is_int = std::fabs(*out) <= 9007199254740992.0;
+      } else {
+        char tmp[512];
+        size_t n = static_cast<size_t>(p - start);
+        if (n >= sizeof(tmp)) {
+          std::string big(start, n);
+          *out = strtod(big.c_str(), nullptr);
+        } else {
+          memcpy(tmp, start, n);
+          tmp[n] = '\0';
+          *out = strtod(tmp, nullptr);
+        }
+        double v = *out;
+        *is_int = integral && std::fabs(v) <= 9007199254740992.0 &&
+                  v == std::floor(v);
+      }
     }
     return true;
   }
 };
 
 // parse one record line, filling matched fields
-bool parse_object(Parser* pr, Scanner* sc, TrieNode* node, int depth) {
+bool parse_object(Parser* pr, Scanner* sc, const TrieNode* node,
+                  int depth) {
   sc->skip_ws();
   if (sc->at_end() || sc->peek() != '{') return false;
   sc->p++;
@@ -451,17 +533,20 @@ bool parse_object(Parser* pr, Scanner* sc, TrieNode* node, int depth) {
   while (true) {
     sc->skip_ws();
     if (sc->at_end() || sc->peek() != '"') return false;
-    if (!sc->read_string(&key)) return false;
+    const char* kspan;
+    size_t klen;
+    if (!sc->read_string_span(&kspan, &klen, &key)) return false;
+    if (klen == static_cast<size_t>(-1)) {
+      kspan = key.data();
+      klen = key.size();
+    }
     sc->skip_ws();
     if (sc->at_end() || sc->peek() != ':') return false;
     sc->p++;
     sc->skip_ws();
 
-    TrieNode* child = nullptr;
-    if (node != nullptr) {
-      auto it = node->children.find(key);
-      if (it != node->children.end()) child = it->second;
-    }
+    const TrieNode* child =
+        (node != nullptr) ? node->find(kspan, klen) : nullptr;
 
     if (child != nullptr) {
       // JSON.parse keeps the LAST occurrence of a duplicate key: any
@@ -494,7 +579,10 @@ bool parse_object(Parser* pr, Scanner* sc, TrieNode* node, int depth) {
         size_t i = f.tags.size() - 1;  // current record slot
         char c = sc->at_end() ? '\0' : sc->peek();
         if (c == '"') {
-          if (!sc->read_string(&sval)) return false;
+          const char* vspan;
+          size_t vlen;
+          if (!sc->read_string_span(&vspan, &vlen, &sval)) return false;
+          if (vlen != static_cast<size_t>(-1)) sval.assign(vspan, vlen);
           f.tags[i] = TAG_STRING;
           f.strcodes[i] = f.dict.code(sval);
           if (f.date_hint) {
@@ -592,8 +680,7 @@ void build_trie(Parser* pr) {
       Item item = frontier.back();
       frontier.pop_back();
       // the full remaining path is a direct key at this level
-      TrieNode*& leaf = item.node->children[item.rest];
-      if (leaf == nullptr) leaf = new TrieNode();
+      TrieNode* leaf = item.node->find_or_add(item.rest);
       uint8_t prio = static_cast<uint8_t>(255 - item.splits);
       if (leaf->field < 0 || prio > leaf->prio) {
         leaf->field = static_cast<int32_t>(fi);
@@ -603,8 +690,7 @@ void build_trie(Parser* pr) {
       if (dot == std::string::npos) continue;
       std::string head = item.rest.substr(0, dot);
       std::string tail = item.rest.substr(dot + 1);
-      TrieNode*& sub = item.node->children[head];
-      if (sub == nullptr) sub = new TrieNode();
+      TrieNode* sub = item.node->find_or_add(head);
       frontier.push_back({sub, tail,
                           static_cast<uint8_t>(item.splits + 1)});
     }
@@ -637,6 +723,7 @@ void* dn_parser_create(const char** paths, const uint8_t* date_hints,
     pr->fields[i].date_hint = date_hints[i] != 0;
   }
   build_trie(pr);
+  pr->trie = &pr->root;
   return pr;
 }
 
@@ -675,7 +762,7 @@ int64_t dn_parser_parse(void* h, const char* buf, int64_t len) {
     sc.skip_ws();
     bool ok;
     if (!sc.at_end() && sc.peek() == '{') {
-      ok = parse_object(pr, &sc, &pr->root, 0);
+      ok = parse_object(pr, &sc, pr->trie, 0);
     } else {
       // any valid JSON value is a record (JSON.parse-per-line
       // semantics); projected fields simply stay missing
@@ -707,6 +794,127 @@ int64_t dn_parser_parse(void* h, const char* buf, int64_t len) {
     p = nl + 1;
   }
   return appended;
+}
+
+void dn_parser_reset_batch(void* h);
+
+// Multithreaded parse: splits the buffer at newline boundaries into
+// nthreads chunks, parses each on a worker with its own field outputs
+// and dictionaries, then appends worker results to the owner in chunk
+// order.  Record order, counters, and dictionary-code assignment order
+// are bit-identical to the single-threaded path: chunks merge in input
+// order, and each worker's new dictionary entries (first-occurrence
+// order within the chunk) are interned into the owner dictionary before
+// any later chunk's.
+int64_t dn_parser_parse_mt(void* h, const char* buf, int64_t len,
+                           int32_t nthreads) {
+  Parser* pr = static_cast<Parser*>(h);
+  if (nthreads < 1) nthreads = 1;
+  // small buffers: threading overhead dominates
+  if (nthreads == 1 || len < (1 << 21)) {
+    return dn_parser_parse(h, buf, len);
+  }
+
+  // chunk boundaries on newlines
+  std::vector<std::pair<const char*, const char*>> chunks;
+  const char* pos = buf;
+  const char* end = buf + len;
+  for (int32_t t = 0; t < nthreads && pos < end; t++) {
+    const char* target = buf + (len * (t + 1)) / nthreads;
+    if (t == nthreads - 1 || target >= end) {
+      chunks.emplace_back(pos, end);
+      pos = end;
+      break;
+    }
+    const char* nl = static_cast<const char*>(
+        memchr(target, '\n', end - target));
+    const char* cend = (nl != nullptr) ? nl + 1 : end;
+    if (cend > pos) chunks.emplace_back(pos, cend);
+    pos = cend;
+  }
+  if (chunks.size() <= 1) return dn_parser_parse(h, buf, len);
+
+  // lazily grow the persistent worker pool
+  while (pr->workers.size() < chunks.size()) {
+    Parser* w = new Parser();
+    w->fields.resize(pr->fields.size());
+    for (size_t i = 0; i < pr->fields.size(); i++) {
+      w->fields[i].date_hint = pr->fields[i].date_hint;
+    }
+    w->trie = &pr->root;
+    pr->workers.push_back(w);
+    pr->remaps.emplace_back(
+        std::vector<std::vector<int32_t>>(pr->fields.size()));
+  }
+
+  std::vector<std::thread> threads;
+  size_t spawned = 0;
+  try {
+    for (size_t t = 0; t < chunks.size(); t++) {
+      Parser* w = pr->workers[t];
+      const char* cbeg = chunks[t].first;
+      const char* cend = chunks[t].second;
+      threads.emplace_back([w, cbeg, cend]() {
+        dn_parser_reset_batch(w);
+        dn_parser_parse(w, cbeg,
+                        static_cast<int64_t>(cend - cbeg));
+      });
+      spawned++;
+    }
+  } catch (...) {
+    // thread creation failed (cgroup pid limit, EAGAIN): join what
+    // started, run the rest inline, and merge as usual
+    for (auto& th : threads) th.join();
+    for (size_t t = spawned; t < chunks.size(); t++) {
+      Parser* w = pr->workers[t];
+      dn_parser_reset_batch(w);
+      dn_parser_parse(w, chunks[t].first,
+                      static_cast<int64_t>(
+                          chunks[t].second - chunks[t].first));
+    }
+    threads.clear();
+  }
+  for (auto& th : threads) th.join();
+
+  // ordered merge
+  int64_t total = 0;
+  for (size_t t = 0; t < chunks.size(); t++) {
+    Parser* w = pr->workers[t];
+    int64_t n = static_cast<int64_t>(w->batch_records);
+    pr->nlines += w->nlines;
+    pr->nbad += w->nbad;
+    w->nlines = 0;
+    w->nbad = 0;
+    w->nrecords = 0;
+    pr->nrecords += static_cast<uint64_t>(n);
+    pr->batch_records += static_cast<uint64_t>(n);
+    total += n;
+    for (size_t fi = 0; fi < pr->fields.size(); fi++) {
+      FieldOut& dst = pr->fields[fi];
+      FieldOut& src = w->fields[fi];
+      // extend the persistent code remap for this worker's new strings
+      std::vector<int32_t>& remap = pr->remaps[t][fi];
+      for (size_t c = remap.size(); c < src.dict.values.size(); c++) {
+        remap.push_back(dst.dict.code(src.dict.values[c]));
+      }
+      dst.tags.insert(dst.tags.end(), src.tags.begin(), src.tags.end());
+      dst.nums.insert(dst.nums.end(), src.nums.begin(), src.nums.end());
+      size_t base = dst.strcodes.size();
+      dst.strcodes.insert(dst.strcodes.end(), src.strcodes.begin(),
+                          src.strcodes.end());
+      for (size_t i = base; i < dst.strcodes.size(); i++) {
+        int32_t c = dst.strcodes[i];
+        if (c >= 0) dst.strcodes[i] = remap[c];
+      }
+      if (dst.date_hint) {
+        dst.datesecs.insert(dst.datesecs.end(), src.datesecs.begin(),
+                            src.datesecs.end());
+        dst.dateerr.insert(dst.dateerr.end(), src.dateerr.begin(),
+                           src.dateerr.end());
+      }
+    }
+  }
+  return total;
 }
 
 int64_t dn_parser_nlines(void* h) {
