@@ -551,10 +551,21 @@ class VectorScan(object):
             # integer weight fits; float or oversized weights use the f64
             # host path (the reference contract is exact sums).
             int_w = bool(np.all(weights == np.floor(weights)))
-            if int_w and float(np.abs(weights).sum()) < 2 ** 31:
+            total = float(np.abs(weights).sum())
+            if int_w and total < 2 ** 31:
+                codes = np.stack(key_codes).astype(np.int32)
+                # small accumulators: fused one-hot matmul on the MXU
+                # (4x the scatter path's throughput on TPU)
+                from .ops import pallas_kernels as pk
+                if pk.should_use(num_segments, total):
+                    agg = pk.make_pallas_aggregate(
+                        tuple(radices), n,
+                        interpret=pk.needs_interpret())
+                    w = weights.astype(np.float32)
+                    return np.asarray(agg(codes, w, alive)).astype(
+                        np.float64)
                 from .ops.kernels import make_aggregate
                 agg = make_aggregate(tuple(radices), n, True)
-                codes = np.stack(key_codes).astype(np.int32)
                 w = weights.astype(np.int32)
                 return np.asarray(agg(codes, w, alive)).astype(np.float64)
 

@@ -38,10 +38,10 @@ def make_mesh(devices=None, axis='d'):
 
 @functools.lru_cache(maxsize=None)
 def _sharded_aggregate_cached(radices, per_device, ndev, scatter,
-                              integer_weights):
+                              integer_weights, use_pallas=False):
     jax, jnp = get_jax()
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     mesh = make_mesh()
     assert len(mesh.devices.flat) == ndev
@@ -51,17 +51,27 @@ def _sharded_aggregate_cached(radices, per_device, ndev, scatter,
         num_segments *= int(r)
     wdtype = 'int32' if integer_weights else 'float32'
 
-    def local_step(codes, weights, alive):
-        # codes: [ncols, per_device] i32; weights/alive: [per_device]
-        fused = jnp.zeros((per_device,), dtype='int32')
-        for i, r in enumerate(radices):
-            fused = fused * jnp.int32(r) + codes[i]
-        fused = jnp.where(alive, fused, num_segments)
-        w = jnp.where(alive, weights.astype(wdtype),
-                      jnp.zeros((), dtype=wdtype))
-        dense = jax.ops.segment_sum(w, fused,
-                                    num_segments=num_segments + 1)
-        return dense[:num_segments]
+    if use_pallas:
+        from ..ops import pallas_kernels as pk
+        interp = pk.needs_interpret()
+
+        def local_step(codes, weights, alive):
+            # fused one-hot matmul per shard (f32; caller guarantees
+            # the total weight is f32-exact)
+            return pk.onehot_dense(radices, per_device, codes,
+                                   weights, alive, interpret=interp)
+    else:
+        def local_step(codes, weights, alive):
+            # codes: [ncols, per_device] i32; weights/alive: [per_device]
+            fused = jnp.zeros((per_device,), dtype='int32')
+            for i, r in enumerate(radices):
+                fused = fused * jnp.int32(r) + codes[i]
+            fused = jnp.where(alive, fused, num_segments)
+            w = jnp.where(alive, weights.astype(wdtype),
+                          jnp.zeros((), dtype=wdtype))
+            dense = jax.ops.segment_sum(w, fused,
+                                        num_segments=num_segments + 1)
+            return dense[:num_segments]
 
     if scatter:
         def step(codes, weights, alive):
@@ -75,9 +85,11 @@ def _sharded_aggregate_cached(radices, per_device, ndev, scatter,
             return jax.lax.psum(dense, 'd')
         out_spec = P()
 
+    # pallas_call does not annotate its outputs with mesh-axis
+    # variance, so the vma check must be off for that path only
     sharded = shard_map(step, mesh=mesh,
                         in_specs=(P(None, 'd'), P('d'), P('d')),
-                        out_specs=out_spec)
+                        out_specs=out_spec, check_vma=not use_pallas)
     return jax.jit(sharded), mesh
 
 
@@ -101,7 +113,8 @@ def sharded_aggregate(key_codes, radices, weights, alive, scatter=False):
     # batch total fits; anything else takes the exact f64 host merge
     # (same guard as the single-device jax path in engine.py).
     int_w = bool(np.all(weights == np.floor(weights)))
-    if not (int_w and float(np.abs(weights).sum()) < 2 ** 31):
+    total = float(np.abs(weights).sum())
+    if not (int_w and total < 2 ** 31):
         # exact-f64 host merge; cannot honor the per-device-slice
         # contract of the scatter variant
         assert not scatter, \
@@ -119,7 +132,13 @@ def sharded_aggregate(key_codes, radices, weights, alive, scatter=False):
         alive = np.pad(alive, (0, pad))
 
     per_device = (n + pad) // ndev
+    # one-hot matmul path for small accumulators; scatter-based
+    # segment-sum otherwise (single gate shared with engine.py)
+    from ..ops import pallas_kernels as pk
+    use_pallas = pk.should_use(num_segments, total)
     fn, mesh = _sharded_aggregate_cached(tuple(int(r) for r in radices),
-                                         per_device, ndev, scatter, True)
-    out = fn(key_codes.astype(np.int32), weights.astype(np.int32), alive)
+                                         per_device, ndev, scatter, True,
+                                         use_pallas)
+    wdev = weights.astype(np.float32 if use_pallas else np.int32)
+    out = fn(key_codes.astype(np.int32), wdev, alive)
     return np.asarray(out).astype(np.float64)
