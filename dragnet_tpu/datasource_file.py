@@ -24,8 +24,8 @@ from . import find as mod_find
 from .aggr import Aggregator
 from .scan import StreamScan
 from .vpipe import Pipeline
-from .index_sink import IndexSink
-from .index_query import IndexQuerier
+from .index_sink import make_index_sink
+from .index_query import open_index
 
 
 def create_datasource(dsconfig):
@@ -468,8 +468,8 @@ class DatasourceFile(object):
         sinks are created lazily per time bucket and each file is written
         atomically.  (reference: lib/datasource-file.js:444-547)"""
         if interval == 'all':
-            sink = IndexSink(metrics,
-                             os.path.join(self.ds_indexpath, 'all'))
+            sink = make_index_sink(metrics,
+                                   os.path.join(self.ds_indexpath, 'all'))
             for fields, value in tagged_points:
                 sink.write(fields, value)
             sink.flush()
@@ -495,7 +495,7 @@ class DatasourceFile(object):
                 bucketstart = jsv.date_parse(bucketname + suffix) // 1000
                 label = bucketname.replace('T', '-')
                 indexpath = os.path.join(root, label + '.sqlite')
-                sinks[bucketname] = IndexSink(
+                sinks[bucketname] = make_index_sink(
                     metrics, indexpath, config={'dn_start': bucketstart})
             sinks[bucketname].write(fields, value)
         for sink in sinks.values():
@@ -557,7 +557,7 @@ class DatasourceFile(object):
                           stage=pipeline.stage('Index Result Aggregator'))
         for path, st in files:
             try:
-                qi = IndexQuerier(path)
+                qi = open_index(path)
             except DNError as e:
                 raise DNError('index "%s"' % path, cause=e)
             try:

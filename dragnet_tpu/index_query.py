@@ -12,6 +12,12 @@ Re-implements lib/index-query.js:
   `==` and double-quoted string literals, so semantics carry over exactly),
 * NULL SUM -> 0, and re-aggregation of returned rows through the standard
   aggregator so per-bucket rows merge into proper points.
+
+Two storage engines share the selection/compilation logic above:
+the reference-compatible SQLite format (IndexQuerier) and the native
+columnar DNC format (index_dnc.DncIndexQuerier, the default writer);
+open_index() sniffs the file content and dispatches — index filenames
+keep the reference's `.sqlite` layout either way.
 """
 
 import copy
@@ -35,30 +41,29 @@ def _semver_satisfies(version, major):
     return int(m.group(1)) == major
 
 
-class IndexQuerier(object):
-    def __init__(self, filename):
-        self.qi_dbfilename = filename
-        self.qi_db = sqlite3.connect(
-            'file:%s?mode=ro' % filename.replace('?', '%3f'), uri=True)
-        self.qi_config = None
-        self.qi_metrics = None
-        self._load_config()
+def open_index(filename):
+    """Open an index file with the engine matching its content."""
+    from . import native_index
+    try:
+        with open(filename, 'rb') as f:
+            head = f.read(len(native_index.MAGIC))
+    except OSError as e:
+        raise DNError(str(e))
+    if head == native_index.MAGIC:
+        from .index_dnc import DncIndexQuerier
+        return DncIndexQuerier(filename)
+    return IndexQuerier(filename)
 
-    def close(self):
-        self.qi_db.close()
 
-    def _load_config(self):
-        cur = self.qi_db.cursor()
-        try:
-            rows = cur.execute('SELECT * FROM dragnet_config').fetchall()
-        except sqlite3.Error as e:
-            raise DNError(str(e))
-        self.qi_config = {}
-        names = [d[0] for d in cur.description]
-        for r in rows:
-            rd = dict(zip(names, r))
-            self.qi_config[rd['key']] = rd['value']
+class IndexQuerierBase(object):
+    """Shared metric selection, filter composition, and row
+    deserialization; subclasses provide _load_config (setting qi_config
+    and qi_metrics) and _execute (returning grouped row dicts)."""
 
+    qi_config = None
+    qi_metrics = None
+
+    def _check_version(self):
         if 'version' not in self.qi_config:
             raise DNError('index missing dragnet "version"')
         if not _semver_satisfies(self.qi_config['version'],
@@ -66,22 +71,18 @@ class IndexQuerier(object):
             raise DNError('unsupported index version: "%s"'
                           % self.qi_config['version'])
 
-        rows = cur.execute('SELECT * FROM dragnet_metrics').fetchall()
-        names = [d[0] for d in cur.description]
-        self.qi_metrics = []
-        for r in rows:
-            rd = dict(zip(names, r))
-            filt = None if rd['filter'] is None else \
-                _json_parse_or_raise(rd['filter'], rd['label'], 'filter')
-            params = [] if rd['params'] is None else \
-                _json_parse_or_raise(rd['params'], rd['label'], 'params')
-            self.qi_metrics.append({
-                'qm_id': rd['id'],
-                'qm_label': rd['label'],
-                'qm_filter': filt,
-                'qm_params': params,
-                'qm_filter_raw': rd['filter'],
-            })
+    def _add_metric(self, mid, label, filter_raw, params_raw):
+        filt = None if filter_raw is None else \
+            _json_parse_or_raise(filter_raw, label, 'filter')
+        params = [] if params_raw is None else \
+            _json_parse_or_raise(params_raw, label, 'params')
+        self.qi_metrics.append({
+            'qm_id': mid,
+            'qm_label': label,
+            'qm_filter': filt,
+            'qm_params': params,
+            'qm_filter_raw': filter_raw,
+        })
 
     def find_metric(self, query):
         """(reference: lib/index-query.js:154-263)"""
@@ -125,23 +126,17 @@ class IndexQuerier(object):
             if okay:
                 return {
                     'datefield': datefield,
+                    'metric_id': met['qm_id'],
                     'table': 'dragnet_index_%s' % met['qm_id'],
                     'ignore_filter': met['qm_filter'] is not None,
                 }
 
         return DNError('no metrics available to serve query')
 
-    def run(self, query, aggr=None):
-        """Execute the query; returns the list of points (or raises
-        DNError).  If `aggr` is given, points are merged into it instead."""
-        table = self.find_metric(query)
-        if isinstance(table, DNError):
-            raise table
-
-        own_aggr = aggr is None
-        if own_aggr:
-            aggr = Aggregator(query)
-
+    def _compose_filter(self, query, table):
+        """The effective pushdown filter: user filter (unless the metric
+        already applied it at build time) ANDed with the time-bounds
+        filter, with column names escaped."""
         whenfilter = mod_query.query_time_bounds_filter(
             query, table['datefield'])
         qfilter = None if table['ignore_filter'] else query.qc_filter
@@ -155,28 +150,28 @@ class IndexQuerier(object):
         else:
             filt = {}
         _escape_filter(filt)
+        return filt
 
-        groupby = [sqlite3_escape(b['name'])
-                   for b in query.qc_breakdowns
-                   if 'date' not in b or b['field'] == b['name']]
-        columns = list(groupby)
-        columns.append('SUM(value) as value')
+    def _groupby_columns(self, query):
+        return [sqlite3_escape(b['name'])
+                for b in query.qc_breakdowns
+                if 'date' not in b or b['field'] == b['name']]
 
-        sql = 'SELECT ' + ','.join(columns)
-        sql += ' from ' + table['table'] + ' '
-        sql += 'WHERE ' + _to_sql_string(filt) + ' '
-        if groupby:
-            sql += 'GROUP BY ' + ','.join(groupby)
+    def run(self, query, aggr=None):
+        """Execute the query; returns the list of points (or raises
+        DNError).  If `aggr` is given, points are merged into it instead."""
+        table = self.find_metric(query)
+        if isinstance(table, DNError):
+            raise table
 
-        try:
-            cur = self.qi_db.execute(sql)
-        except sqlite3.Error as e:
-            raise DNError('executing query "%s"' % sql,
-                          cause=DNError(str(e)))
-        names = [d[0] for d in cur.description]
-        points = []
-        for row in cur.fetchall():
-            rd = dict(zip(names, row))
+        own_aggr = aggr is None
+        if own_aggr:
+            aggr = Aggregator(query)
+
+        filt = self._compose_filter(query, table)
+        groupby = self._groupby_columns(query)
+
+        for rd in self._execute(table, filt, groupby):
             fields, value = self._deserialize_row(query, rd)
             aggr.write(fields, value)
         if own_aggr:
@@ -195,6 +190,61 @@ class IndexQuerier(object):
                 fields[field['name']] = rd[col]
             # absent column: leave unset (JS undefined semantics)
         return (fields, value)
+
+
+class IndexQuerier(IndexQuerierBase):
+    """The reference-compatible SQLite engine."""
+
+    def __init__(self, filename):
+        self.qi_dbfilename = filename
+        self.qi_db = sqlite3.connect(
+            'file:%s?mode=ro' % filename.replace('?', '%3f'), uri=True)
+        self.qi_config = None
+        self.qi_metrics = None
+        self._load_config()
+
+    def close(self):
+        self.qi_db.close()
+
+    def _load_config(self):
+        cur = self.qi_db.cursor()
+        try:
+            rows = cur.execute('SELECT * FROM dragnet_config').fetchall()
+        except sqlite3.Error as e:
+            raise DNError(str(e))
+        self.qi_config = {}
+        names = [d[0] for d in cur.description]
+        for r in rows:
+            rd = dict(zip(names, r))
+            self.qi_config[rd['key']] = rd['value']
+        self._check_version()
+
+        rows = cur.execute('SELECT * FROM dragnet_metrics').fetchall()
+        names = [d[0] for d in cur.description]
+        self.qi_metrics = []
+        for r in rows:
+            rd = dict(zip(names, r))
+            self._add_metric(rd['id'], rd['label'], rd['filter'],
+                             rd['params'])
+
+    def _execute(self, table, filt, groupby):
+        columns = list(groupby)
+        columns.append('SUM(value) as value')
+
+        sql = 'SELECT ' + ','.join(columns)
+        sql += ' from ' + table['table'] + ' '
+        sql += 'WHERE ' + _to_sql_string(filt) + ' '
+        if groupby:
+            sql += 'GROUP BY ' + ','.join(groupby)
+
+        try:
+            cur = self.qi_db.execute(sql)
+        except sqlite3.Error as e:
+            raise DNError('executing query "%s"' % sql,
+                          cause=DNError(str(e)))
+        names = [d[0] for d in cur.description]
+        for row in cur.fetchall():
+            yield dict(zip(names, row))
 
 
 def _json_parse_or_raise(text, label, what):

@@ -25,6 +25,29 @@ def sqlite3_escape(name):
     return name.replace('.', '_').replace('-', '_')
 
 
+def metric_catalog_rows(metrics):
+    """(id, label, filter, params) rows of the embedded metric catalog —
+    identical strings in both storage engines so metric selection
+    behaves the same whichever wrote the file."""
+    rows = []
+    for i, m in enumerate(metrics):
+        ms = mod_query.metric_serialize(m, skip_datasource=True)
+        rows.append((i, m.m_name, jsv.json_stringify(m.m_filter),
+                     jsv.json_stringify(ms['breakdowns'])))
+    return rows
+
+
+def make_index_sink(metrics, filename, config=None):
+    """Index writer for the configured format: DN_INDEX_FORMAT=dnc (the
+    native columnar store, default) or sqlite (reference-compatible
+    files).  Readers dispatch on file content, so either is queryable."""
+    fmt = os.environ.get('DN_INDEX_FORMAT', 'dnc')
+    if fmt == 'sqlite':
+        return IndexSink(metrics, filename, config=config)
+    from .index_dnc import DncIndexSink
+    return DncIndexSink(metrics, filename, config=config)
+
+
 class IndexSink(object):
     def __init__(self, metrics, filename, config=None):
         self.is_metrics = metrics
@@ -72,17 +95,8 @@ class IndexSink(object):
         cur.executemany('INSERT INTO dragnet_config VALUES (?, ?)',
                         configpairs)
 
-        metricrows = []
-        for i, m in enumerate(metrics):
-            ms = mod_query.metric_serialize(m, skip_datasource=True)
-            metricrows.append((
-                i,
-                m.m_name,
-                jsv.json_stringify(m.m_filter),
-                jsv.json_stringify(ms['breakdowns']),
-            ))
         cur.executemany('INSERT INTO dragnet_metrics VALUES (?, ?, ?, ?)',
-                        metricrows)
+                        metric_catalog_rows(metrics))
 
     def write(self, fields, value):
         """Write one aggregated point; fields must carry __dn_metric."""

@@ -33,12 +33,14 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _SO_PATH = os.path.join(_NATIVE_DIR, 'build', 'libdnparse.so')
 
 
-def _build():
-    src = os.path.join(_NATIVE_DIR, 'dnparse.cc')
+def _build_target(so_path, src):
+    """Build (via the shared Makefile) the native library at so_path
+    from src if it is missing or stale; True when a loadable library is
+    present afterward."""
     if not os.path.exists(src):
-        return os.path.exists(_SO_PATH)
-    if os.path.exists(_SO_PATH) and \
-            os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+        return os.path.exists(so_path)
+    if os.path.exists(so_path) and \
+            os.path.getmtime(so_path) >= os.path.getmtime(src):
         return True
     try:
         # serialize concurrent builds (multi-process cluster launches)
@@ -47,23 +49,31 @@ def _build():
         lockpath = os.path.join(_NATIVE_DIR, 'build', '.lock')
         with open(lockpath, 'w') as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
-            if not (os.path.exists(_SO_PATH) and os.path.getmtime(
-                    _SO_PATH) >= os.path.getmtime(src)):
-                subprocess.run(['make', '-C', _NATIVE_DIR],
+            if not (os.path.exists(so_path) and os.path.getmtime(
+                    so_path) >= os.path.getmtime(src)):
+                # build the specific target so a compile failure in one
+                # library cannot fail the other's build
+                target = os.path.relpath(so_path, _NATIVE_DIR)
+                subprocess.run(['make', '-C', _NATIVE_DIR, target],
                                check=True, stdout=subprocess.DEVNULL,
                                stderr=subprocess.DEVNULL)
     except Exception:
         # a stale-but-loadable library beats the 9x-slower fallback,
         # but its semantics may lag the source — say so
-        if os.path.exists(_SO_PATH):
+        if os.path.exists(so_path):
             import sys
             sys.stderr.write(
-                'dn: warning: native parser rebuild failed; using '
-                'stale %s (set DN_NATIVE=0 to force the Python '
-                'path)\n' % _SO_PATH)
+                'dn: warning: native rebuild failed; using stale %s '
+                '(set DN_NATIVE=0 to force the Python path)\n'
+                % so_path)
             return True
         return False
-    return os.path.exists(_SO_PATH)
+    return os.path.exists(so_path)
+
+
+def _build():
+    return _build_target(_SO_PATH, os.path.join(_NATIVE_DIR,
+                                                'dnparse.cc'))
 
 
 def get_lib():

@@ -1,0 +1,422 @@
+// dnindex: memory-mapped columnar index store.
+//
+// The native index engine replacing the reference's only native
+// component, the sqlite3 binding (lib/index-sink.js, lib/index-query.js
+// store aggregated points in SQLite tables and answer queries with
+// SELECT cols, SUM(value) ... WHERE ... GROUP BY cols).  Here the index
+// artifact is a single column-oriented file:
+//
+//   [header]  magic "DNCIDX1\n", u32 version, u32 pad,
+//             u64 footer_off, u64 footer_len   (patched at finalize)
+//   [blocks]  8-byte-aligned column blocks: i64 data, i32 dictionary
+//             codes, u32 dictionary offsets, utf-8 dictionary bytes,
+//             f64 values, u8 integrality flags
+//   [footer]  JSON: config pairs (version 2.0.0, dn_start...), the
+//             metric catalog, and per-table column descriptors with
+//             block offsets
+//
+// The file is self-describing and atomically renamed into place by the
+// caller, preserving the reference's durability contract
+// (lib/index-sink.js:264-304).  Reads mmap the file; column arrays are
+// exposed zero-copy to numpy, predicate masks are evaluated vectorized
+// in Python with SQLite type-affinity semantics, and the GROUP BY / SUM
+// hot loop runs here (dn_idx_groupby): fused-key dense accumulation
+// when the key space is small, hash aggregation otherwise, with groups
+// emitted in ascending key order exactly as SQLite's sorter would.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'D', 'N', 'C', 'I', 'D', 'X', '1', '\n'};
+constexpr uint32_t kVersion = 1;
+constexpr int64_t kHeaderSize = 32;
+
+struct Writer {
+  int fd = -1;
+  int64_t off = 0;
+  bool failed = false;
+};
+
+struct Reader {
+  const uint8_t* base = nullptr;
+  int64_t size = 0;
+  int64_t footer_off = 0;
+  int64_t footer_len = 0;
+};
+
+struct GroupResult {
+  int32_t nkeys = 0;
+  int64_t ngroups = 0;
+  std::vector<int64_t> keys;  // ngroups * nkeys, row-major
+  std::vector<double> sums;
+  std::vector<uint8_t> isint;
+};
+
+bool write_all(int fd, const void* buf, int64_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = write(fd, p, static_cast<size_t>(len));
+    if (n < 0) {
+      if (errno == EINTR)
+        continue;
+      return false;
+    }
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// writer
+
+void* dn_idx_writer_create(const char* path) {
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0)
+    return nullptr;
+  Writer* w = new Writer();
+  w->fd = fd;
+  // header placeholder; footer_off/footer_len patched at finalize
+  char header[kHeaderSize];
+  memset(header, 0, sizeof(header));
+  memcpy(header, kMagic, sizeof(kMagic));
+  memcpy(header + 8, &kVersion, sizeof(kVersion));
+  if (!write_all(fd, header, kHeaderSize)) {
+    close(fd);
+    delete w;
+    return nullptr;
+  }
+  w->off = kHeaderSize;
+  return w;
+}
+
+// Appends a block, padding to 8-byte alignment first; returns the
+// block's file offset, or -1 on I/O error.
+int64_t dn_idx_writer_block(void* h, const void* buf, int64_t len) {
+  Writer* w = static_cast<Writer*>(h);
+  if (w->failed)
+    return -1;
+  static const char zeros[8] = {0};
+  int64_t pad = (8 - (w->off & 7)) & 7;
+  if (pad && !write_all(w->fd, zeros, pad)) {
+    w->failed = true;
+    return -1;
+  }
+  w->off += pad;
+  int64_t at = w->off;
+  if (len > 0 && !write_all(w->fd, buf, len)) {
+    w->failed = true;
+    return -1;
+  }
+  w->off += len;
+  return at;
+}
+
+// Writes the footer JSON, patches the header, and closes.  No fsync —
+// the reference disables synchronous writes too (pragma synchronous =
+// off, lib/index-sink.js:169-178); atomicity comes from the caller's
+// tmp-file + rename.  Returns 0 on success.
+int32_t dn_idx_writer_finalize(void* h, const char* footer,
+                               int64_t footer_len) {
+  Writer* w = static_cast<Writer*>(h);
+  int64_t at = dn_idx_writer_block(h, footer, footer_len);
+  int32_t rv = -1;
+  if (at >= 0 && !w->failed) {
+    char patch[16];
+    memcpy(patch, &at, 8);
+    memcpy(patch + 8, &footer_len, 8);
+    if (pwrite(w->fd, patch, sizeof(patch), 16) == sizeof(patch))
+      rv = 0;
+  }
+  if (close(w->fd) != 0)
+    rv = -1;
+  delete w;
+  return rv;
+}
+
+void dn_idx_writer_abort(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  close(w->fd);
+  delete w;
+}
+
+// ---------------------------------------------------------------------
+// reader
+
+void* dn_idx_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0)
+    return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < kHeaderSize) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                    MAP_PRIVATE, fd, 0);
+  close(fd);  // mmap keeps its own reference
+  if (base == MAP_FAILED)
+    return nullptr;
+  const uint8_t* p = static_cast<const uint8_t*>(base);
+  uint32_t version;
+  memcpy(&version, p + 8, 4);
+  Reader* r = new Reader();
+  r->base = p;
+  r->size = st.st_size;
+  memcpy(&r->footer_off, p + 16, 8);
+  memcpy(&r->footer_len, p + 24, 8);
+  if (memcmp(p, kMagic, sizeof(kMagic)) != 0 || version != kVersion ||
+      r->footer_off < kHeaderSize || r->footer_len < 0 ||
+      r->footer_off + r->footer_len > r->size) {
+    munmap(const_cast<uint8_t*>(r->base), static_cast<size_t>(r->size));
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+const uint8_t* dn_idx_base(void* h) {
+  return static_cast<Reader*>(h)->base;
+}
+
+int64_t dn_idx_size(void* h) {
+  return static_cast<Reader*>(h)->size;
+}
+
+int64_t dn_idx_footer_off(void* h) {
+  return static_cast<Reader*>(h)->footer_off;
+}
+
+int64_t dn_idx_footer_len(void* h) {
+  return static_cast<Reader*>(h)->footer_len;
+}
+
+void dn_idx_close(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  munmap(const_cast<uint8_t*>(r->base), static_cast<size_t>(r->size));
+  delete r;
+}
+
+// ---------------------------------------------------------------------
+// GROUP BY / SUM kernel
+//
+// keycols: nkeys column arrays of rank-encoded keys (the Python side
+// maps dictionary codes to byte-order ranks so ascending rank ==
+// SQLite BINARY-collation order; integer columns pass through).  mask
+// selects the rows surviving the WHERE clause.  Sums accumulate in f64;
+// a group's result is integral only if every contributing row was
+// (SQLite's SUM returns REAL once any operand is REAL).
+
+void* dn_idx_groupby(const int64_t** keycols, int32_t nkeys,
+                     const double* values, const uint8_t* isint,
+                     const uint8_t* mask, int64_t nrows) {
+  GroupResult* g = new GroupResult();
+  g->nkeys = nkeys;
+
+  if (nkeys == 0) {
+    // single group over all surviving rows (matches SELECT SUM(value)
+    // with no GROUP BY only when rows exist; caller handles empty)
+    double sum = 0.0;
+    uint8_t allint = 1;
+    int64_t seen = 0;
+    for (int64_t i = 0; i < nrows; i++) {
+      if (!mask[i])
+        continue;
+      sum += values[i];
+      allint &= isint[i];
+      seen++;
+    }
+    if (seen > 0) {
+      g->ngroups = 1;
+      g->sums.push_back(sum);
+      g->isint.push_back(allint);
+    }
+    return g;
+  }
+
+  // Fused-key path: mixed-radix composite when every key range is known
+  // and the product fits comfortably (dense accumulator, O(n)).
+  int64_t lo[16], hi[16];
+  bool fused_ok = nkeys <= 16;
+  if (fused_ok) {
+    bool any = false;
+    for (int32_t k = 0; k < nkeys; k++) {
+      lo[k] = INT64_MAX;
+      hi[k] = INT64_MIN;
+    }
+    for (int64_t i = 0; i < nrows; i++) {
+      if (!mask[i])
+        continue;
+      any = true;
+      for (int32_t k = 0; k < nkeys; k++) {
+        int64_t v = keycols[k][i];
+        if (v < lo[k])
+          lo[k] = v;
+        if (v > hi[k])
+          hi[k] = v;
+      }
+    }
+    if (!any)
+      return g;
+    int64_t space = 1;
+    for (int32_t k = 0; k < nkeys && fused_ok; k++) {
+      int64_t range = hi[k] - lo[k] + 1;
+      if (range <= 0 || space > (int64_t(1) << 42) / range)
+        fused_ok = false;
+      else
+        space *= range;
+    }
+    if (fused_ok && space > (1 << 22) && space > nrows * 4)
+      fused_ok = false;  // too sparse for a dense accumulator
+    if (fused_ok) {
+      std::vector<double> acc(static_cast<size_t>(space), 0.0);
+      std::vector<uint8_t> accint(static_cast<size_t>(space), 1);
+      std::vector<uint8_t> present(static_cast<size_t>(space), 0);
+      for (int64_t i = 0; i < nrows; i++) {
+        if (!mask[i])
+          continue;
+        int64_t fused = 0;
+        for (int32_t k = 0; k < nkeys; k++)
+          fused = fused * (hi[k] - lo[k] + 1) + (keycols[k][i] - lo[k]);
+        acc[fused] += values[i];
+        accint[fused] &= isint[i];
+        present[fused] = 1;
+      }
+      // ascending fused order == ascending lexicographic key order
+      for (int64_t f = 0; f < space; f++) {
+        if (!present[f])
+          continue;
+        int64_t rem = f;
+        int64_t key[16];
+        for (int32_t k = nkeys - 1; k >= 0; k--) {
+          int64_t range = hi[k] - lo[k] + 1;
+          key[k] = lo[k] + rem % range;
+          rem /= range;
+        }
+        for (int32_t k = 0; k < nkeys; k++)
+          g->keys.push_back(key[k]);
+        g->sums.push_back(acc[f]);
+        g->isint.push_back(accint[f]);
+        g->ngroups++;
+      }
+      return g;
+    }
+  }
+
+  // Hash path: 64-bit mixed key -> group slot; final sort by key tuple.
+  struct Slot {
+    double sum = 0.0;
+    uint8_t allint = 1;
+    int64_t first = 0;  // index into tuples
+  };
+  std::unordered_map<uint64_t, std::vector<int64_t>> buckets;
+  std::vector<int64_t> tuples;  // flattened candidate key tuples
+  std::vector<Slot> slots;
+  buckets.reserve(1024);
+  for (int64_t i = 0; i < nrows; i++) {
+    if (!mask[i])
+      continue;
+    uint64_t hv = 1469598103934665603ull;  // FNV-1a over the tuple
+    for (int32_t k = 0; k < nkeys; k++) {
+      uint64_t v = static_cast<uint64_t>(keycols[k][i]);
+      for (int b = 0; b < 8; b++) {
+        hv ^= (v >> (b * 8)) & 0xff;
+        hv *= 1099511628211ull;
+      }
+    }
+    auto& cands = buckets[hv];
+    int64_t slot = -1;
+    for (int64_t s : cands) {
+      bool eq = true;
+      for (int32_t k = 0; k < nkeys; k++) {
+        if (tuples[slots[s].first + k] != keycols[k][i]) {
+          eq = false;
+          break;
+        }
+      }
+      if (eq) {
+        slot = s;
+        break;
+      }
+    }
+    if (slot < 0) {
+      slot = static_cast<int64_t>(slots.size());
+      Slot ns;
+      ns.first = static_cast<int64_t>(tuples.size());
+      for (int32_t k = 0; k < nkeys; k++)
+        tuples.push_back(keycols[k][i]);
+      slots.push_back(ns);
+      cands.push_back(slot);
+    }
+    slots[slot].sum += values[i];
+    slots[slot].allint &= isint[i];
+  }
+
+  std::vector<int64_t> order(slots.size());
+  for (size_t s = 0; s < slots.size(); s++)
+    order[s] = static_cast<int64_t>(s);
+  std::sort(order.begin(), order.end(),
+            [&](int64_t a, int64_t b) {
+              const int64_t* ta = &tuples[slots[a].first];
+              const int64_t* tb = &tuples[slots[b].first];
+              for (int32_t k = 0; k < nkeys; k++) {
+                if (ta[k] != tb[k])
+                  return ta[k] < tb[k];
+              }
+              return false;
+            });
+  g->ngroups = static_cast<int64_t>(order.size());
+  g->keys.reserve(order.size() * nkeys);
+  for (int64_t s : order) {
+    const int64_t* t = &tuples[slots[s].first];
+    for (int32_t k = 0; k < nkeys; k++)
+      g->keys.push_back(t[k]);
+    g->sums.push_back(slots[s].sum);
+    g->isint.push_back(slots[s].allint);
+  }
+  return g;
+}
+
+int64_t dn_gb_ngroups(void* gh) {
+  return static_cast<GroupResult*>(gh)->ngroups;
+}
+
+// Copies group keys for key column k (ngroups values).
+void dn_gb_keys(void* gh, int32_t k, int64_t* out) {
+  GroupResult* g = static_cast<GroupResult*>(gh);
+  for (int64_t i = 0; i < g->ngroups; i++)
+    out[i] = g->keys[i * g->nkeys + k];
+}
+
+void dn_gb_sums(void* gh, double* out) {
+  GroupResult* g = static_cast<GroupResult*>(gh);
+  memcpy(out, g->sums.data(), static_cast<size_t>(g->ngroups) * 8);
+}
+
+void dn_gb_isint(void* gh, uint8_t* out) {
+  GroupResult* g = static_cast<GroupResult*>(gh);
+  memcpy(out, g->isint.data(), static_cast<size_t>(g->ngroups));
+}
+
+void dn_gb_free(void* gh) {
+  delete static_cast<GroupResult*>(gh);
+}
+
+}  // extern "C"

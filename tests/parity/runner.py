@@ -94,6 +94,9 @@ class DnRunner(object):
                 def _write():
                     try:
                         os.write(wfd, data)
+                    except BrokenPipeError:
+                        # the command exited without draining fd 0
+                        pass
                     finally:
                         os.close(wfd)
 

@@ -1,0 +1,305 @@
+"""The native columnar index store (DNC) vs the SQLite engine.
+
+The two storage engines must be observationally identical: same query
+results (values AND row order — SQLite's GROUP BY sorter order is part
+of the observable contract the goldens pin down), same metric-selection
+behavior, same version gate, same atomic-artifact discipline.  The DNC
+differential tests here drive both engines over the same data through
+the full filter matrix; the byte-level tests pin the format invariants
+(native and pure-Python writers emit identical files)."""
+
+import json
+import os
+import struct
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import query as mod_query  # noqa: E402
+from dragnet_tpu import native_index  # noqa: E402
+from dragnet_tpu.errors import DNError  # noqa: E402
+from dragnet_tpu.index_dnc import DncIndexQuerier, DncIndexSink  # noqa: E402
+from dragnet_tpu.index_query import IndexQuerier, open_index  # noqa: E402
+from dragnet_tpu.index_sink import IndexSink  # noqa: E402
+
+
+def _metric(breakdowns, filter=None):
+    """breakdowns: 'name' or 'name[aggr[,step]]' comma-joined."""
+    bds = []
+    for spec in breakdowns.split(','):
+        if '[' in spec:
+            name, attrs = spec.split('[', 1)
+            b = {'name': name, 'field': name}
+            for attr in attrs.rstrip(']').split(';'):
+                k, v = attr.split('=')
+                b[k] = int(v) if v.isdigit() else v
+            bds.append(b)
+        else:
+            bds.append({'name': spec, 'field': spec})
+    mconf = {'name': 'met', 'breakdowns': bds}
+    if filter is not None:
+        mconf['filter'] = filter
+    return mod_query.metric_deserialize(mconf)
+
+
+def _points(metric, rows):
+    """Tag rows for metric 0 the way the build fan-out does."""
+    out = []
+    for fields, value in rows:
+        f = dict(fields)
+        f['__dn_metric'] = 0
+        out.append((f, value))
+    return out
+
+
+ROWS = [
+    ({'host': 'a', 'req.method': 'GET', 'latency': 4, '__dn_ts': 100},
+     3),
+    ({'host': 'a', 'req.method': 'PUT', 'latency': 8, '__dn_ts': 100},
+     1),
+    ({'host': 'b', 'req.method': 'GET', 'latency': 4, '__dn_ts': 200},
+     2),
+    ({'host': 'b', 'req.method': 'DELETE', 'latency': 16,
+      '__dn_ts': 200}, 5),
+    ({'host': 'c10', 'req.method': 'GET', 'latency': 4, '__dn_ts': 300},
+     7),
+    ({'host': 'c2', 'req.method': 'HEAD', 'latency': 32,
+      '__dn_ts': 300}, 1),
+]
+
+METRIC_BD = 'host,req.method,latency[aggr=quantize],' \
+    '__dn_ts[aggr=lquantize;step=100]'
+
+
+def _build_both(tmp_path, rows=ROWS, breakdowns=None):
+    m = _metric(breakdowns or METRIC_BD)
+    sq = str(tmp_path / 'sq.sqlite')
+    dn = str(tmp_path / 'dn.sqlite')
+    s1 = IndexSink([m], sq, config={'dn_start': 0})
+    s2 = DncIndexSink([m], dn, config={'dn_start': 0})
+    for fields, value in _points(m, rows):
+        s1.write(fields, value)
+        s2.write(fields, value)
+    s1.flush()
+    s2.flush()
+    return sq, dn
+
+
+QUERIES = [
+    {'breakdowns': [{'name': 'host'}]},
+    {'breakdowns': [{'name': 'req.method'}, {'name': 'host'}]},
+    {'breakdowns': [{'name': 'latency', 'aggr': 'quantize'}]},
+    {'breakdowns': [{'name': 'host'},
+                    {'name': 'latency', 'aggr': 'quantize'}]},
+    {},
+    {'filter': {'eq': ['req.method', 'GET']},
+     'breakdowns': [{'name': 'host'}]},
+    {'filter': {'ne': ['req.method', 'GET']},
+     'breakdowns': [{'name': 'host'}]},
+    {'filter': {'or': [{'eq': ['host', 'a']}, {'gt': ['latency', 8]}]},
+     'breakdowns': [{'name': 'req.method'}]},
+    {'filter': {'and': [{'le': ['latency', 8]},
+                        {'lt': ['host', 'b']}]},
+     'breakdowns': [{'name': 'host'}]},
+    # numeric constant against a text column (affinity conversion)
+    {'filter': {'eq': ['host', 10]}, 'breakdowns': [{'name': 'host'}]},
+    # text constant against an integer column
+    {'filter': {'eq': ['latency', '8']},
+     'breakdowns': [{'name': 'host'}]},
+    {'filter': {'lt': ['latency', 'zzz']},
+     'breakdowns': [{'name': 'host'}]},
+    # filter matched nothing
+    {'filter': {'eq': ['host', 'nope']},
+     'breakdowns': [{'name': 'host'}]},
+    {'filter': {'eq': ['host', 'nope']}},
+]
+
+
+def test_differential_queries(tmp_path):
+    sq, dn = _build_both(tmp_path)
+    for qconf in QUERIES:
+        q = mod_query.query_load(dict(qconf))
+        assert not isinstance(q, DNError), qconf
+        r1 = IndexQuerier(sq).run(q)
+        r2 = DncIndexQuerier(dn).run(q)
+        assert r1 == r2, qconf
+
+
+def test_differential_random(tmp_path):
+    import random
+    rng = random.Random(1234)
+    hosts = ['h%d' % i for i in range(17)] + ['', 'zz', 'a b', 'é']
+    methods = ['GET', 'PUT', 'POST']
+    rows = []
+    for i in range(500):
+        rows.append((
+            {'host': rng.choice(hosts),
+             'req.method': rng.choice(methods),
+             'latency': rng.choice([0, 1, 3, 4, 7, 100, 2 ** 20]),
+             '__dn_ts': rng.randrange(0, 1000)},
+            rng.choice([1, 2, 0.5]),
+        ))
+    sq, dn = _build_both(tmp_path, rows=rows)
+    queries = []
+    for trial in range(30):
+        ops = ['eq', 'ne', 'lt', 'le', 'gt', 'ge']
+        leaf = {rng.choice(ops): [
+            rng.choice(['host', 'latency']),
+            rng.choice(['h3', 'h12', 0, 4, '4', 'x']),
+        ]}
+        queries.append({
+            'filter': leaf,
+            'breakdowns': [{'name': rng.choice(['host', 'req.method'])}],
+        })
+    for qconf in queries:
+        q = mod_query.query_load(dict(qconf))
+        r1 = IndexQuerier(sq).run(q)
+        r2 = DncIndexQuerier(dn).run(q)
+        assert r1 == r2, qconf
+
+
+def test_open_index_sniffs_format(tmp_path):
+    sq, dn = _build_both(tmp_path)
+    assert isinstance(open_index(sq), IndexQuerier)
+    assert isinstance(open_index(dn), DncIndexQuerier)
+    with open(dn, 'rb') as f:
+        assert f.read(8) == native_index.MAGIC
+    with open(sq, 'rb') as f:
+        assert f.read(6) == b'SQLite'
+
+
+def test_native_and_python_writers_byte_identical(tmp_path):
+    m = _metric(METRIC_BD)
+    pts = _points(m, ROWS)
+
+    s1 = DncIndexSink([m], str(tmp_path / 'native.idx'),
+                      config={'dn_start': 0})
+    for f, v in pts:
+        s1.write(f, v)
+    s1.flush()
+
+    os.environ['DN_NATIVE'] = '0'
+    try:
+        # force the pure-Python writer/reader path
+        native_index._lib = None
+        s2 = DncIndexSink([m], str(tmp_path / 'python.idx'),
+                          config={'dn_start': 0})
+        for f, v in pts:
+            s2.write(f, v)
+        s2.flush()
+        b1 = open(tmp_path / 'native.idx', 'rb').read()
+        b2 = open(tmp_path / 'python.idx', 'rb').read()
+        assert b1 == b2
+
+        # and the numpy fallback reader answers identically
+        q = mod_query.query_load({'breakdowns': [{'name': 'host'}]})
+        r_py = DncIndexQuerier(str(tmp_path / 'python.idx')).run(q)
+    finally:
+        del os.environ['DN_NATIVE']
+        native_index._lib = None
+    r_nat = DncIndexQuerier(str(tmp_path / 'native.idx')).run(q)
+    assert r_py == r_nat
+
+
+def test_incompatible_values_fall_back_to_sqlite(tmp_path):
+    # non-numeric text in an INTEGER-affinity column: SQLite would store
+    # TEXT in-row; DNC cannot, so the sink transparently writes a
+    # SQLite file instead (readers sniff per file)
+    m = _metric('host,latency[aggr=quantize]')
+    path = str(tmp_path / 'fb.sqlite')
+    s = DncIndexSink([m], path)
+    s.write({'host': 'a', 'latency': 4, '__dn_metric': 0}, 1)
+    s.write({'host': 'b', 'latency': 'oops', '__dn_metric': 0}, 2)
+    s.flush()
+    with open(path, 'rb') as f:
+        assert f.read(6) == b'SQLite'
+    assert isinstance(open_index(path), IndexQuerier)
+
+
+def test_version_gate(tmp_path):
+    _, dn = _build_both(tmp_path)
+    raw = open(dn, 'rb').read()
+    foff, flen = struct.unpack('<qq', raw[16:32])
+    footer = json.loads(raw[foff:foff + flen].decode())
+    footer['config']['version'] = '3.0.0'
+    nf = json.dumps(footer).encode()
+    bad = str(tmp_path / 'bad.sqlite')
+    with open(bad, 'wb') as f:
+        f.write(raw[:foff] + nf)
+        f.seek(16)
+        f.write(struct.pack('<qq', foff, len(nf)))
+    with pytest.raises(DNError) as ei:
+        open_index(bad)
+    assert 'unsupported index version' in str(ei.value)
+
+
+def test_malformed_footer_raises_dnerror(tmp_path):
+    # corrupt DNC files must fail with DNError at open (the datasource
+    # catches DNError and reports 'index "<path>"'), never KeyError
+    bad = str(tmp_path / 'bad.sqlite')
+    footer = json.dumps({'config': {'version': '2.0.0'}}).encode()
+    with open(bad, 'wb') as f:
+        f.write(native_index.MAGIC)
+        f.write(struct.pack('<II', native_index.FORMAT_VERSION, 0))
+        f.write(struct.pack('<qq', 32, len(footer)))
+        f.write(footer)
+    with pytest.raises(DNError):
+        open_index(bad)
+
+    truncated = str(tmp_path / 'trunc.sqlite')
+    with open(truncated, 'wb') as f:
+        f.write(native_index.MAGIC)
+        f.write(struct.pack('<II', native_index.FORMAT_VERSION, 0))
+        f.write(struct.pack('<qq', 10 ** 9, 64))
+    with pytest.raises(DNError):
+        open_index(truncated)
+
+
+def test_float_text_affinity_matches_sqlite(tmp_path):
+    # floats landing in a TEXT-affinity column render exactly as
+    # SQLite's %!.15g would ('1.0e+20', '2.0', negative zero -> '0.0')
+    m = _metric('host')
+    rows = [({'host': v}, 1) for v in
+            (1e20, -0.0, 2.0, 2.5, 1e15, 123456789012345.6,
+             3.141592653589793, 5e-324, 1e-4)]
+    sq = str(tmp_path / 'sq.sqlite')
+    dn = str(tmp_path / 'dn.sqlite')
+    s1 = IndexSink([m], sq)
+    s2 = DncIndexSink([m], dn)
+    for f, v in _points(m, rows):
+        s1.write(f, v)
+        s2.write(f, v)
+    s1.flush()
+    s2.flush()
+    q = mod_query.query_load({'breakdowns': [{'name': 'host'}]})
+    r1 = IndexQuerier(sq).run(q)
+    r2 = DncIndexQuerier(dn).run(q)
+    assert r1 == r2
+
+
+def test_null_group_and_empty_sum(tmp_path):
+    # NULL keys group separately and sort first (SQLite NULL-first);
+    # an aggregate query over zero surviving rows yields the NULL-sum
+    # row that deserializes to 0
+    m = _metric('host')
+    rows = [({'host': None}, 2), ({'host': 'a'}, 3),
+            ({'host': None}, 4)]
+    sq = str(tmp_path / 'sq.sqlite')
+    dn = str(tmp_path / 'dn.sqlite')
+    s1 = IndexSink([m], sq)
+    s2 = DncIndexSink([m], dn)
+    for f, v in _points(m, rows):
+        s1.write(f, v)
+        s2.write(f, v)
+    s1.flush()
+    s2.flush()
+    for qconf in ({'breakdowns': [{'name': 'host'}]},
+                  {},
+                  {'filter': {'eq': ['host', 'zzz']}}):
+        q = mod_query.query_load(dict(qconf))
+        r1 = IndexQuerier(sq).run(q)
+        r2 = DncIndexQuerier(dn).run(q)
+        assert r1 == r2, qconf
