@@ -173,13 +173,18 @@ class DatasourceFile(object):
         concatenated bytes, projected fields only, batched into the
         vectorized engine.  (The byte stream is the concatenation of all
         files — a partial trailing line joins across file boundaries,
-        matching catstreams semantics.)"""
+        matching catstreams semantics.)  With DN_SCAN_THREADS > 0 the
+        engine step runs on worker threads pipelined behind the parse
+        (scan_mt), with byte-identical results."""
         from . import native as mod_native
-        from .engine import BATCH_SIZE
+        from .engine import BATCH_SIZE, NativeColumns, VectorScan
+        from . import scan_mt
 
         stages = mod_ingest.make_parser_stages(pipeline, fmt)
         parser_stage, adapter_stage = stages
-        scanner = self._vector_scan_cls()(
+        stage_offset = len(pipeline.stages)
+        scan_cls = self._vector_scan_cls()
+        scanner = scan_cls(
             query, self.ds_timefield, pipeline, ds_filter=self.ds_filter)
 
         skinner = fmt == 'json-skinner'
@@ -193,31 +198,66 @@ class DatasourceFile(object):
         parser = mod_native.NativeParser(paths, hints)
         remap = {p: np_ for p, np_ in
                  zip([p for p, h in proj], paths)} if skinner else None
-        # one provider for the whole scan so per-column caches
-        # (decoded array values etc.) persist across batches
-        src = _RemappedParser(parser, remap) if skinner else parser
 
-        def flush():
-            n = parser.batch_size()
-            if n == 0:
-                return
-            nlines, nbad = parser.counters()
-            parser_stage.counters['ninputs'] = nlines
-            parser_stage.counters['noutputs'] = nlines - nbad
-            if nbad:
-                parser_stage.counters['invalid json'] = nbad
-            if adapter_stage is not None:
-                adapter_stage.bump('ninputs', n)
-                adapter_stage.bump('noutputs', n)
-            if skinner:
-                tags, nums, strcodes = parser.columns('value')
-                weights = _skinner_weights(tags, nums, strcodes, parser)
-            else:
-                weights = np.ones(n, dtype=np.float64)
-            scanner.write_native_batch(src, weights)
-            parser.reset_batch()
+        nworkers = scan_mt.scan_threads()
+        use_mt = nworkers > 0 and scan_cls is VectorScan
 
-        self._stream_native(files, parser, flush, BATCH_SIZE)
+        if use_mt:
+            def build_worker(wp):
+                wscan = scan_cls(query, self.ds_timefield, wp,
+                                 ds_filter=self.ds_filter)
+                rec = scan_mt.BatchRecorder(wscan.aggr.stage)
+                wscan.aggr = rec
+
+                def process(snap):
+                    src = _RemappedParser(snap, remap) if skinner \
+                        else snap
+                    provider = NativeColumns(src)
+                    wscan._process(provider,
+                                   _batch_weights(skinner, snap,
+                                                  snap.batch_size()))
+                    return rec.drain()
+                return process
+
+            def apply_result(calls):
+                for keys, value in calls:
+                    scanner.aggr.write_key(keys, value)
+
+            ex = scan_mt.MTScanExecutor(nworkers, build_worker,
+                                        apply_result, pipeline,
+                                        stage_offset)
+
+            def flush():
+                n = parser.batch_size()
+                if n == 0:
+                    return
+                snap = scan_mt.ParserSnapshot(parser, paths, hints)
+                parser.reset_batch()
+                _bump_parse_counters(parser_stage, adapter_stage,
+                                     snap.nlines, snap.nbad, n)
+                ex.submit(snap)
+
+            try:
+                self._stream_native(files, parser, flush, BATCH_SIZE)
+            finally:
+                ex.finish()
+        else:
+            # one provider for the whole scan so per-column caches
+            # (decoded array values etc.) persist across batches
+            src = _RemappedParser(parser, remap) if skinner else parser
+
+            def flush():
+                n = parser.batch_size()
+                if n == 0:
+                    return
+                nlines, nbad = parser.counters()
+                _bump_parse_counters(parser_stage, adapter_stage,
+                                     nlines, nbad, n)
+                weights = _batch_weights(skinner, parser, n)
+                scanner.write_native_batch(src, weights)
+                parser.reset_batch()
+
+            self._stream_native(files, parser, flush, BATCH_SIZE)
         # counters even when the final batch was empty
         nlines, nbad = parser.counters()
         if nlines:
@@ -341,31 +381,42 @@ class DatasourceFile(object):
         """Build fan-out over the native parser: ONE pass over raw bytes
         feeds every metric's vectorized scan (the reference pipes one
         parse stream into N StreamScans, lib/datasource-file.js:403-427;
-        here one columnar provider feeds N engine passes)."""
+        here one columnar provider feeds N engine passes, parallelized
+        across worker threads when DN_SCAN_THREADS > 0)."""
         from . import native as mod_native
-        from . import engine as mod_engine
-        from .engine import BATCH_SIZE, NativeColumns, VectorPredicate
+        from .engine import (BATCH_SIZE, NativeColumns, VectorPredicate,
+                             VectorScan)
+        from . import scan_mt
+        from .ops.kernels import TRUE
 
         stages = mod_ingest.make_parser_stages(pipeline, fmt)
         parser_stage, adapter_stage = stages
+        stage_offset = len(pipeline.stages)
+        scan_cls = self._vector_scan_cls()
 
         class _Holder(object):
-            raw_columns = {}
-            filter_fields = []
+            def __init__(self):
+                self.raw_columns = {}
+                self.filter_fields = []
 
-        ds_pred = None
-        ds_stage = None
-        if filter is not None:
-            holder = _Holder()
-            ds_pred = VectorPredicate(filter, holder)
-            ds_stage = pipeline.stage('Datasource filter')
+        def make_scan_set(pl):
+            """The per-pipeline scan state: datasource predicate (+its
+            stage) and one VectorScan per metric; identical stage
+            layout on the main and every worker pipeline."""
+            pred = stage = None
+            if filter is not None:
+                holder = _Holder()
+                pred = VectorPredicate(filter, holder)
+                stage = pl.stage('Datasource filter')
+            scans = []
+            for q in queries:
+                s = scan_cls(q, self.ds_timefield, pl, ds_filter=None)
+                pl.stage('Add __dn_metric')
+                scans.append(s)
+            return pred, stage, scans, holder if filter is not None \
+                else None
 
-        scanners = []
-        for q in queries:
-            s = self._vector_scan_cls()(q, self.ds_timefield, pipeline,
-                                        ds_filter=None)
-            pipeline.stage('Add __dn_metric')
-            scanners.append(s)
+        ds_pred, ds_stage, scanners, holder = make_scan_set(pipeline)
 
         skinner = fmt == 'json-skinner'
         proj = {}
@@ -386,46 +437,92 @@ class DatasourceFile(object):
         parser = mod_native.NativeParser(paths, hints)
         remap = {p: np_ for (p, h), np_ in zip(items, paths)} \
             if skinner else None
-        # one provider object per build so per-column caches persist
-        src = _RemappedParser(parser, remap) if skinner else parser
 
-        from .ops.kernels import TRUE
+        def eval_ds_filter(pred, stage, provider, n):
+            stage.bump('ninputs', n)
+            out = pred.outcomes(provider)
+            nfail = int((out == 2).sum())
+            ndrop = int((out == 0).sum())
+            if nfail:
+                stage.bump('nfailedeval', nfail)
+            if ndrop:
+                stage.bump('nfilteredout', ndrop)
+            alive0 = out == TRUE
+            stage.bump('noutputs', int(alive0.sum()))
+            return alive0
 
-        def flush():
-            n = parser.batch_size()
-            if n == 0:
-                return
-            nlines, nbad = parser.counters()
-            parser_stage.counters['ninputs'] = nlines
-            parser_stage.counters['noutputs'] = nlines - nbad
-            if nbad:
-                parser_stage.counters['invalid json'] = nbad
-            if adapter_stage is not None:
-                adapter_stage.bump('ninputs', n)
-                adapter_stage.bump('noutputs', n)
-            provider = NativeColumns(src)
-            if skinner:
-                tags, nums, strcodes = parser.columns('value')
-                weights = _skinner_weights(tags, nums, strcodes, parser)
-            else:
-                weights = np.ones(n, dtype=np.float64)
-            alive0 = None
-            if ds_pred is not None:
-                ds_stage.bump('ninputs', n)
-                out = ds_pred.outcomes(provider)
-                nfail = int((out == 2).sum())
-                ndrop = int((out == 0).sum())
-                if nfail:
-                    ds_stage.bump('nfailedeval', nfail)
-                if ndrop:
-                    ds_stage.bump('nfilteredout', ndrop)
-                alive0 = out == TRUE
-                ds_stage.bump('noutputs', int(alive0.sum()))
-            for s in scanners:
-                s._process(provider, weights, alive=alive0)
-            parser.reset_batch()
+        nworkers = scan_mt.scan_threads()
+        if nworkers > 0 and scan_cls is VectorScan:
+            def build_worker(wp):
+                wpred, wstage, wscans, _ = make_scan_set(wp)
+                recs = []
+                for s in wscans:
+                    rec = scan_mt.BatchRecorder(s.aggr.stage)
+                    s.aggr = rec
+                    recs.append(rec)
 
-        self._stream_native(files, parser, flush, BATCH_SIZE)
+                def process(snap):
+                    n = snap.batch_size()
+                    src = _RemappedParser(snap, remap) if skinner \
+                        else snap
+                    provider = NativeColumns(src)
+                    weights = _batch_weights(skinner, snap, n)
+                    alive0 = None
+                    if wpred is not None:
+                        alive0 = eval_ds_filter(wpred, wstage,
+                                                provider, n)
+                    out = []
+                    for s, rec in zip(wscans, recs):
+                        s._process(provider, weights, alive=alive0)
+                        out.append(rec.drain())
+                    return out
+                return process
+
+            def apply_result(results):
+                for s_main, calls in zip(scanners, results):
+                    for keys, value in calls:
+                        s_main.aggr.write_key(keys, value)
+
+            ex = scan_mt.MTScanExecutor(nworkers, build_worker,
+                                        apply_result, pipeline,
+                                        stage_offset)
+
+            def flush():
+                n = parser.batch_size()
+                if n == 0:
+                    return
+                snap = scan_mt.ParserSnapshot(parser, paths, hints)
+                parser.reset_batch()
+                _bump_parse_counters(parser_stage, adapter_stage,
+                                     snap.nlines, snap.nbad, n)
+                ex.submit(snap)
+
+            try:
+                self._stream_native(files, parser, flush, BATCH_SIZE)
+            finally:
+                ex.finish()
+        else:
+            # one provider object per build so per-column caches persist
+            src = _RemappedParser(parser, remap) if skinner else parser
+
+            def flush():
+                n = parser.batch_size()
+                if n == 0:
+                    return
+                nlines, nbad = parser.counters()
+                _bump_parse_counters(parser_stage, adapter_stage,
+                                     nlines, nbad, n)
+                provider = NativeColumns(src)
+                weights = _batch_weights(skinner, parser, n)
+                alive0 = None
+                if ds_pred is not None:
+                    alive0 = eval_ds_filter(ds_pred, ds_stage, provider,
+                                            n)
+                for s in scanners:
+                    s._process(provider, weights, alive=alive0)
+                parser.reset_batch()
+
+            self._stream_native(files, parser, flush, BATCH_SIZE)
         nlines, nbad = parser.counters()
         if nlines:
             parser_stage.counters['ninputs'] = nlines
@@ -573,6 +670,27 @@ class DatasourceFile(object):
                 aggr.write(fields, value)
 
         return ScanResult(pipeline, points=aggr.points(), query=query)
+
+
+def _bump_parse_counters(parser_stage, adapter_stage, nlines, nbad, n):
+    """Parse-layer counters (totals are monotonic; assigned, not
+    accumulated) plus the per-batch adapter bumps."""
+    parser_stage.counters['ninputs'] = nlines
+    parser_stage.counters['noutputs'] = nlines - nbad
+    if nbad:
+        parser_stage.counters['invalid json'] = nbad
+    if adapter_stage is not None and n:
+        adapter_stage.bump('ninputs', n)
+        adapter_stage.bump('noutputs', n)
+
+
+def _batch_weights(skinner, src, n):
+    """Per-record weights for one batch: 1 for raw json, the coerced
+    point value for json-skinner (src is a parser or snapshot)."""
+    if skinner:
+        tags, nums, strcodes = src.columns('value')
+        return _skinner_weights(tags, nums, strcodes, src)
+    return np.ones(n, dtype=np.float64)
 
 
 def _skinner_weights(tags, nums, strcodes, parser):

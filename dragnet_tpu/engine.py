@@ -61,6 +61,25 @@ def _native_str_trans(column, parser_dict):
     return cache
 
 
+def _compact_codes(ords):
+    """np.unique(return_inverse=True) for integer arrays, O(n) via a
+    dense presence table when the value range is small (bucket ordinals
+    always are), falling back to np.unique otherwise."""
+    if len(ords) == 0:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+    mn = int(ords.min())
+    mx = int(ords.max())
+    span = mx - mn + 1
+    if span > max(65536, 4 * len(ords)):
+        uniq, codes = np.unique(ords, return_inverse=True)
+        return uniq, codes.astype(np.int64)
+    shifted = ords - mn
+    present = np.zeros(span, dtype=bool)
+    present[shifted] = True
+    lut = np.cumsum(present) - 1
+    return np.nonzero(present)[0] + mn, lut[shifted]
+
+
 def weights_array(values):
     """Point weights -> f64 with JS Number coercion (json-skinner values
     may be strings or garbage; NaN becomes 0 rather than poisoning
@@ -470,8 +489,8 @@ class VectorScan(object):
                     self.aggr.stage.bump('nnonnumeric', nbadnum)
                 alive = alive & valid
                 ords = self._bucketize(b, vals)
-                uniq, codes = np.unique(ords, return_inverse=True)
-                key_codes.append(codes.astype(np.int64))
+                uniq, codes = _compact_codes(ords)
+                key_codes.append(codes)
                 decoders.append([int(u) for u in uniq])
             else:
                 col = self.string_columns[name]
@@ -505,13 +524,17 @@ class VectorScan(object):
         # reference emits those too), and in what order: inserting each
         # distinct tuple at its first-occurrence position makes the
         # nested-dict walk reproduce the host path's emission order
-        # exactly.
+        # exactly.  O(n): reversed fancy assignment keeps each code's
+        # FIRST occurrence index; the sort is over groups, not records.
         fused_host = np.zeros(n, dtype=np.int64)
         for codes, r in zip(key_codes, radices):
             fused_host = fused_host * r + codes
-        uniq, first_idx = np.unique(fused_host[alive], return_index=True)
-        order = np.argsort(first_idx, kind='stable')
-        for fused in uniq[order].tolist():
+        first = np.full(num_segments, -1, dtype=np.int64)
+        idx = np.nonzero(alive)[0]
+        first[fused_host[idx[::-1]]] = idx[::-1]
+        occurred = np.nonzero(first >= 0)[0]
+        order = np.argsort(first[occurred], kind='stable')
+        for fused in occurred[order].tolist():
             w = dense[fused]
             key = []
             f = fused

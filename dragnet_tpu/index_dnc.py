@@ -211,20 +211,21 @@ class DncIndexSink(object):
         self.is_config = dict(config or {})
         self.is_nwritten = 0
         self._rows = [[] for _ in metrics]
+        self._names = [[b['b_name'] for b in m.m_breakdowns]
+                       for m in metrics]
 
         dirname = os.path.dirname(self.is_dbtmpfilename)
         if dirname:
             os.makedirs(dirname, exist_ok=True)
 
     def write(self, fields, value):
+        # hot loop: one call per aggregated point; a missing breakdown
+        # raises KeyError like the SQLite sink's asserts would
         mi = fields['__dn_metric']
-        assert isinstance(mi, int) and 0 <= mi < len(self.is_metrics)
-        m = self.is_metrics[mi]
-        row = []
-        for b in m.m_breakdowns:
-            assert b['b_name'] in fields
-            row.append(fields[b['b_name']])
-        self._rows[mi].append((row, value))
+        if not (isinstance(mi, int) and mi >= 0):
+            raise IndexError('bad __dn_metric: %r' % (mi,))
+        self._rows[mi].append(
+            ([fields[name] for name in self._names[mi]], value))
         self.is_nwritten += 1
 
     def _columnarize(self):
@@ -247,10 +248,12 @@ class DncIndexSink(object):
                     index = {}
                     values = []
                     for i, r in enumerate(rows):
-                        t = _text_affinity(r[0][ci])
-                        if t is None:
-                            codes[i] = -1
-                            continue
+                        t = r[0][ci]
+                        if type(t) is not str:  # fast path: usual case
+                            t = _text_affinity(t)
+                            if t is None:
+                                codes[i] = -1
+                                continue
                         c = index.get(t)
                         if c is None:
                             c = len(values)
@@ -261,7 +264,12 @@ class DncIndexSink(object):
             vals = np.empty(n, dtype=np.float64)
             flags = np.empty(n, dtype=np.uint8)
             for i, r in enumerate(rows):
-                vals[i], flags[i] = _value_affinity(r[1])
+                v = r[1]
+                if type(v) is int:  # fast path: integer weights
+                    vals[i] = v
+                    flags[i] = 1
+                else:
+                    vals[i], flags[i] = _value_affinity(v)
             tables.append((n, cols, vals, flags))
         return tables
 

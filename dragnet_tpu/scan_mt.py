@@ -1,0 +1,223 @@
+"""Multithreaded host scan: parse / engine pipelining and fan-out.
+
+The reference's hot loop was a single-threaded chain of per-record
+callbacks (lib/stream-scan.js; SURVEY §3.1).  The native parser already
+parallelizes the byte->column step across cores; this module overlaps
+and parallelizes the *engine* step (predicate masks, bucketize,
+segment-sum) with it:
+
+    main thread:  read -> native parse -> snapshot columns -> work queue
+    W workers:    snapshot -> VectorScan._process -> per-batch key list
+    merger:       applies each batch's (key, weight) calls to the real
+                  aggregators IN BATCH ORDER
+
+Replaying batches in input order makes the result — including the
+aggregator's insertion-ordered emission, which the goldens pin — byte-
+identical to the sequential path, because the sequential engine also
+inserts keys batch by batch in first-occurrence order.  Workers never
+share mutable scan state: each owns its VectorScan instances (their
+dictionaries and predicate tables), and decoded keys (real strings /
+bucket ordinals) are what crosses threads.  Counter parity: each worker
+bumps its own pipeline's stages, which mirror the main pipeline's scan
+stages one-to-one and are summed into them at the end.
+
+DN_SCAN_THREADS sets the worker count (auto = up to 6, bounded by CPU
+count; 0 disables the executor entirely).
+"""
+
+import os
+import queue
+import threading
+
+
+def scan_threads():
+    v = os.environ.get('DN_SCAN_THREADS', 'auto')
+    if v != 'auto':
+        try:
+            return max(0, int(v))
+        except ValueError:
+            return 0
+    return max(1, min(6, os.cpu_count() or 1))
+
+
+class PinnedList(object):
+    """Fixed-length view of an append-only list.  The parser's Python
+    dictionary mirrors only ever grow; pinning the length makes a
+    worker's iteration/len/slicing immune to appends the main thread
+    performs for later batches (entries below the pin are immutable)."""
+
+    __slots__ = ('_lst', '_n')
+
+    def __init__(self, lst, n):
+        self._lst = lst
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return self._lst[:self._n][i]
+        if i >= self._n or i < -self._n:
+            raise IndexError(i)
+        return self._lst[i]
+
+    def __iter__(self):
+        lst = self._lst
+        for i in range(self._n):
+            yield lst[i]
+
+
+class ParserSnapshot(object):
+    """Immutable copy of one parsed batch, safe to hand to a worker
+    while the main thread keeps parsing.  Column arrays are fresh copies
+    (NativeParser.columns copies out of the C buffers); dictionaries are
+    length-pinned views of the parser's append-only Python mirrors —
+    codes in this batch only reference entries below the pin."""
+
+    def __init__(self, parser, paths, hints):
+        self._n = parser.batch_size()
+        self._cols = {}
+        self._dates = {}
+        self._dicts = {}
+        for p, h in zip(paths, hints):
+            self._cols[p] = parser.columns(p)
+            d = parser.dictionary(p)
+            self._dicts[p] = PinnedList(d, len(d))
+            if h:
+                self._dates[p] = parser.date_columns(p)
+        self.nlines, self.nbad = parser.counters()
+
+    def batch_size(self):
+        return self._n
+
+    def columns(self, path):
+        return self._cols[path]
+
+    def date_columns(self, path):
+        return self._dates[path]
+
+    def dictionary(self, path):
+        return self._dicts[path]
+
+
+class BatchRecorder(object):
+    """Aggregator stand-in for worker scans: records write_key calls in
+    order so the merger can replay them into the real aggregator."""
+
+    def __init__(self, stage):
+        self.stage = stage
+        self.calls = []
+
+    def write_key(self, keys, value):
+        self.calls.append((keys, value))
+
+    def drain(self):
+        calls = self.calls
+        self.calls = []
+        return calls
+
+
+class MTScanExecutor(object):
+    """Generic fan-out: enqueue snapshots, run build_worker()'s process
+    function on them across nworkers threads, apply results in order.
+
+    build_worker() -> (process, finish) runs once per worker thread:
+    process(snapshot) returns a result object, finish(worker_pipeline)
+    is unused state capture (the pipeline is merged by the executor).
+    apply_result(result) runs on the merger thread in sequence order.
+    """
+
+    QUEUE_DEPTH = 4
+
+    def __init__(self, nworkers, build_worker, apply_result,
+                 main_pipeline, stage_offset):
+        from .vpipe import Pipeline
+        self.nworkers = nworkers
+        self.apply_result = apply_result
+        self.main_pipeline = main_pipeline
+        self.stage_offset = stage_offset
+        self.workq = queue.Queue(maxsize=self.QUEUE_DEPTH + nworkers)
+        self.resultq = queue.Queue()
+        self.errors = []
+        self.seq = 0
+        self.worker_pipelines = []
+        self.threads = []
+        for _ in range(nworkers):
+            wp = Pipeline()
+            self.worker_pipelines.append(wp)
+            t = threading.Thread(target=self._worker,
+                                 args=(build_worker, wp), daemon=True)
+            t.start()
+            self.threads.append(t)
+        self.merger = threading.Thread(target=self._merge, daemon=True)
+        self.merger.start()
+
+    def _worker(self, build_worker, wp):
+        try:
+            process = build_worker(wp)
+        except BaseException as e:  # surface setup failures at submit
+            self.errors.append(e)
+            process = None
+        while True:
+            item = self.workq.get()
+            if item is None:
+                return
+            seq, snap = item
+            if self.errors:
+                self.resultq.put((seq, None))
+                continue
+            try:
+                self.resultq.put((seq, process(snap)))
+            except BaseException as e:
+                self.errors.append(e)
+                self.resultq.put((seq, None))
+
+    def _merge(self):
+        pending = {}
+        want = 0
+        while True:
+            item = self.resultq.get()
+            if item is None:
+                return
+            seq, result = item
+            pending[seq] = result
+            while want in pending:
+                result = pending.pop(want)
+                want += 1
+                if result is None or self.errors:
+                    continue
+                try:
+                    self.apply_result(result)
+                except BaseException as e:
+                    self.errors.append(e)
+
+    def submit(self, snapshot):
+        if self.errors:
+            self.close()
+            raise self.errors[0]
+        self.workq.put((self.seq, snapshot))
+        self.seq += 1
+
+    def close(self):
+        for _ in self.threads:
+            self.workq.put(None)
+        for t in self.threads:
+            t.join()
+        self.resultq.put(None)
+        self.merger.join()
+        self.threads = []
+
+    def finish(self):
+        """Drain everything, merge worker counters into the main
+        pipeline, and re-raise the first worker error."""
+        self.close()
+        if self.errors:
+            raise self.errors[0]
+        main_stages = self.main_pipeline.stages[self.stage_offset:]
+        for wp in self.worker_pipelines:
+            assert len(wp.stages) <= len(main_stages)
+            for ms, ws in zip(main_stages, wp.stages):
+                assert ms.name == ws.name
+                for counter, value in ws.counters.items():
+                    ms.bump(counter, value)
