@@ -92,6 +92,46 @@ def run_host(lines, query):
     return s.aggr
 
 
+def run_build_query(datafile, nrecords):
+    """Secondary metrics: `dn build` throughput (index construction,
+    BASELINE.json's second config) and index-query p50 latency over the
+    built daily indexes."""
+    import shutil
+    from dragnet_tpu.datasource_file import DatasourceFile
+
+    idx = datafile + '.idx'
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile, 'indexPath': idx,
+                              'timeField': 'time'},
+        'ds_filter': None,
+        'ds_format': 'json',
+    })
+    metric = mod_query.metric_deserialize({'name': 'm', 'breakdowns': [
+        {'name': 'timestamp', 'field': 'time', 'date': '',
+         'aggr': 'lquantize', 'step': 86400},
+        {'name': 'host', 'field': 'host'},
+        {'name': 'req.method', 'field': 'req.method'},
+        {'name': 'operation', 'field': 'operation'},
+        {'name': 'latency', 'field': 'latency', 'aggr': 'quantize'}]})
+    t0 = time.time()
+    ds.build([metric], 'day')
+    build_s = time.time() - t0
+
+    qq = mod_query.query_load({
+        'breakdowns': [{'name': 'host'},
+                       {'name': 'latency', 'aggr': 'quantize'}],
+        'filter': {'eq': ['req.method', 'GET']}})
+    times = []
+    for _ in range(15):
+        t0 = time.time()
+        ds.query(qq, 'day')
+        times.append(time.time() - t0)
+    times.sort()
+    shutil.rmtree(idx, ignore_errors=True)
+    return nrecords / build_s, times[len(times) // 2]
+
+
 def main():
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     host_sample = min(nrecords, 50000)
@@ -124,21 +164,23 @@ def main():
     run_host(lines[:host_sample], q())
     host_s = time.time() - t0
 
+    build_rps, query_p50 = run_build_query(datafile, nrecords)
+
     vec_rps = nrecords / vec_s
     host_rps = host_sample / host_s
 
     sys.stderr.write(
         'bench: %d records, %d output points; gen %.1fs; '
         'dn-scan %.2fs (%.0f rec/s); host-sample %.2fs (%.0f rec/s); '
-        'engine=%s native=%s\n'
+        'dn-build %.0f rec/s; index-query p50 %.1fms; '
+        'engine=%s native=%s threads=%s\n'
         % (nrecords, npoints, gen_s, vec_s, vec_rps, host_s, host_rps,
+           build_rps, query_p50 * 1000,
            os.environ.get('DN_ENGINE', 'auto'),
-           os.environ.get('DN_NATIVE', '1')))
-    try:
-        os.unlink(datafile)
-        os.rmdir(tmpdir)
-    except OSError:
-        pass
+           os.environ.get('DN_NATIVE', '1'),
+           os.environ.get('DN_SCAN_THREADS', 'auto')))
+    import shutil
+    shutil.rmtree(tmpdir, ignore_errors=True)
 
     print(json.dumps({
         'metric': 'scan_records_per_sec',

@@ -719,6 +719,12 @@ class _RemappedParser(object):
     def __init__(self, parser, remap):
         self.parser = parser
         self.remap = remap
+        # alias the wrapped parser's decoded-array cache (if it has
+        # one) so per-batch wrappers don't defeat it (the engine
+        # caches on the provider's parser attribute)
+        cache = getattr(parser, '_array_cache', None)
+        if cache is not None:
+            self._array_cache = cache
 
     def batch_size(self):
         return self.parser.batch_size()

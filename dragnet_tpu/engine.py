@@ -524,17 +524,27 @@ class VectorScan(object):
         # reference emits those too), and in what order: inserting each
         # distinct tuple at its first-occurrence position makes the
         # nested-dict walk reproduce the host path's emission order
-        # exactly.  O(n): reversed fancy assignment keeps each code's
-        # FIRST occurrence index; the sort is over groups, not records.
+        # exactly.
         fused_host = np.zeros(n, dtype=np.int64)
         for codes, r in zip(key_codes, radices):
             fused_host = fused_host * r + codes
-        first = np.full(num_segments, -1, dtype=np.int64)
         idx = np.nonzero(alive)[0]
-        first[fused_host[idx[::-1]]] = idx[::-1]
-        occurred = np.nonzero(first >= 0)[0]
-        order = np.argsort(first[occurred], kind='stable')
-        for fused in occurred[order].tolist():
+        if num_segments <= max(65536, 4 * n):
+            # dense: reversed fancy assignment keeps each code's FIRST
+            # occurrence index in O(n + segments); the sort is over
+            # groups, not records
+            first = np.full(num_segments, -1, dtype=np.int64)
+            first[fused_host[idx[::-1]]] = idx[::-1]
+            occurred = np.nonzero(first >= 0)[0]
+            order = np.argsort(first[occurred], kind='stable')
+            fused_order = occurred[order]
+        else:
+            # sparse key space: sort only the alive records
+            uniq, first_idx = np.unique(fused_host[idx],
+                                        return_index=True)
+            order = np.argsort(first_idx, kind='stable')
+            fused_order = uniq[order]
+        for fused in fused_order.tolist():
             w = dense[fused]
             key = []
             f = fused

@@ -60,7 +60,8 @@ class PinnedList(object):
             return self._lst[:self._n][i]
         if i >= self._n or i < -self._n:
             raise IndexError(i)
-        return self._lst[i]
+        # resolve negatives against the pinned length, not the live list
+        return self._lst[i + self._n] if i < 0 else self._lst[i]
 
     def __iter__(self):
         lst = self._lst
@@ -87,6 +88,16 @@ class ParserSnapshot(object):
             if h:
                 self._dates[p] = parser.date_columns(p)
         self.nlines, self.nbad = parser.counters()
+        # share the engine's decoded-array-values cache across batches:
+        # it lives on the persistent parser, every snapshot aliases it
+        # (engine keys entries by dictionary length, so concurrent
+        # readers at older pins stay correct — extra entries decode to
+        # codes their batch never contains)
+        cache = getattr(parser, '_array_cache', None)
+        if cache is None:
+            cache = {}
+            parser._array_cache = cache
+        self._array_cache = cache
 
     def batch_size(self):
         return self._n

@@ -51,17 +51,54 @@ enum DateErr : uint8_t {
   DATE_BAD = 2,
 };
 
+// Open-addressing interning dictionary keyed by byte span: the hot
+// path (per projected string per record) never constructs a temporary
+// std::string or runs std::hash — FNV over the raw span, linear probe,
+// memcmp against the stored value.
 struct StringDict {
-  std::unordered_map<std::string, int32_t> index;
   std::vector<std::string> values;
+  std::vector<int32_t> table = std::vector<int32_t>(64, -1);
+  size_t mask = 63;
+
+  static uint64_t hash_span(const char* s, size_t len) {
+    uint64_t h = 1469598103934665603ull;
+    for (size_t i = 0; i < len; i++) {
+      h ^= static_cast<unsigned char>(s[i]);
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+
+  void grow() {
+    size_t nsize = table.size() * 2;
+    std::vector<int32_t> ntable(nsize, -1);
+    size_t nmask = nsize - 1;
+    for (int32_t c = 0; c < static_cast<int32_t>(values.size()); c++) {
+      size_t i = hash_span(values[c].data(), values[c].size()) & nmask;
+      while (ntable[i] != -1) i = (i + 1) & nmask;
+      ntable[i] = c;
+    }
+    table.swap(ntable);
+    mask = nmask;
+  }
+
+  int32_t code_span(const char* s, size_t len) {
+    size_t i = hash_span(s, len) & mask;
+    while (table[i] != -1) {
+      const std::string& v = values[table[i]];
+      if (v.size() == len && memcmp(v.data(), s, len) == 0)
+        return table[i];
+      i = (i + 1) & mask;
+    }
+    int32_t c = static_cast<int32_t>(values.size());
+    values.emplace_back(s, len);
+    table[i] = c;
+    if (values.size() * 4 > table.size() * 3) grow();
+    return c;
+  }
 
   int32_t code(const std::string& s) {
-    auto it = index.find(s);
-    if (it != index.end()) return it->second;
-    int32_t c = static_cast<int32_t>(values.size());
-    index.emplace(s, c);
-    values.push_back(s);
-    return c;
+    return code_span(s.data(), s.size());
   }
 };
 
@@ -91,8 +128,29 @@ struct TrieNode {
   // honor JSON.parse last-occurrence-wins when a later duplicate key
   // replaces a whole subtree (earlier captures must be cleared)
   std::vector<std::pair<int32_t, uint8_t>> subtree_fields;
+  // first-byte dispatch: most record keys are not projected, and a
+  // single table load rejects them without touching the child list
+  // (-1 = no child starts with this byte, -2 = several do: scan,
+  // >= 0 = the only candidate child).  Built by fill_subtree_fields.
+  int16_t first_map[256];
+
+  TrieNode() { memset(first_map, -1, sizeof(first_map)); }
 
   TrieNode* find(const char* k, size_t len) const {
+    if (len == 0) return find_scan(k, len);  // empty projected key
+    int16_t fm = first_map[static_cast<unsigned char>(k[0])];
+    if (fm == -1) return nullptr;
+    if (fm >= 0) {
+      const auto& kv = children[fm];
+      if (kv.first.size() == len &&
+          memcmp(kv.first.data(), k, len) == 0) {
+        return kv.second;
+      }
+      return nullptr;
+    }
+    return find_scan(k, len);
+  }
+  TrieNode* find_scan(const char* k, size_t len) const {
     for (const auto& kv : children) {
       if (kv.first.size() == len &&
           memcmp(kv.first.data(), k, len) == 0) {
@@ -102,11 +160,20 @@ struct TrieNode {
     return nullptr;
   }
   TrieNode* find_or_add(const std::string& k) {
-    TrieNode* n = find(k.data(), k.size());
+    TrieNode* n = find_scan(k.data(), k.size());
     if (n != nullptr) return n;
     n = new TrieNode();
     children.emplace_back(k, n);
     return n;
+  }
+  void build_first_map() {
+    memset(first_map, -1, sizeof(first_map));
+    for (size_t i = 0; i < children.size(); i++) {
+      if (children[i].first.empty()) continue;
+      unsigned char b =
+          static_cast<unsigned char>(children[i].first[0]);
+      first_map[b] = first_map[b] == -1 ? static_cast<int16_t>(i) : -2;
+    }
   }
   ~TrieNode() {
     for (auto& kv : children) delete kv.second;
@@ -245,6 +312,34 @@ bool parse_iso_date(const char* s, size_t len, int64_t* ms_out) {
 // ---------------------------------------------------------------------
 // JSON scanning
 
+// SWAR scan: advance to the first byte that is '"', '\\', or a raw
+// control char (< 0x20), 8 bytes per step.  These are the only bytes a
+// JSON string scanner must act on; everything else is literal content.
+static inline const char* scan_plain(const char* p, const char* end) {
+  constexpr uint64_t kOnes = 0x0101010101010101ull;
+  constexpr uint64_t kHigh = 0x8080808080808080ull;
+  while (end - p >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    uint64_t q = w ^ (kOnes * 0x22);          // '"'
+    uint64_t b = w ^ (kOnes * 0x5C);          // '\\'
+    uint64_t c = w & (kOnes * 0xE0);          // 0 iff byte < 0x20
+    uint64_t hit = ((q - kOnes) & ~q & kHigh) |
+                   ((b - kOnes) & ~b & kHigh) |
+                   ((c - kOnes) & ~c & kHigh);
+    if (hit)
+      return p + (__builtin_ctzll(hit) >> 3);
+    p += 8;
+  }
+  while (p < end) {
+    unsigned char ch = static_cast<unsigned char>(*p);
+    if (ch == '"' || ch == '\\' || ch < 0x20)
+      return p;
+    p++;
+  }
+  return end;
+}
+
 struct Scanner {
   const char* p;
   const char* end;
@@ -262,36 +357,34 @@ struct Scanner {
     // no raw control chars) so the skip path rejects exactly what
     // JSON.parse / json.loads reject
     p++;
-    while (p < end) {
+    while (true) {
+      p = scan_plain(p, end);
+      if (p >= end) return false;
       unsigned char c = static_cast<unsigned char>(*p);
-      if (c == '\\') {
-        p++;
-        if (p >= end) return false;
-        char e = *p;
-        if (e == 'u') {
-          if (end - p < 5) return false;
-          for (int i = 1; i <= 4; i++) {
-            char h = p[i];
-            if (!((h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
-                  (h >= 'A' && h <= 'F'))) return false;
-          }
-          p += 5;
-        } else if (e == '"' || e == '\\' || e == '/' || e == 'b' ||
-                   e == 'f' || e == 'n' || e == 'r' || e == 't') {
-          p++;
-        } else {
-          return false;
-        }
-      } else if (c == '"') {
+      if (c == '"') {
         p++;
         return true;
-      } else if (c < 0x20) {
-        return false;
-      } else {
+      }
+      if (c < 0x20) return false;
+      // backslash escape
+      p++;
+      if (p >= end) return false;
+      char e = *p;
+      if (e == 'u') {
+        if (end - p < 5) return false;
+        for (int i = 1; i <= 4; i++) {
+          char h = p[i];
+          if (!((h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
+                (h >= 'A' && h <= 'F'))) return false;
+        }
+        p += 5;
+      } else if (e == '"' || e == '\\' || e == '/' || e == 'b' ||
+                 e == 'f' || e == 'n' || e == 'r' || e == 't') {
         p++;
+      } else {
+        return false;
       }
     }
-    return false;
   }
 
   // Scan a JSON string assuming *p == '"'.  Fast path: no escapes and
@@ -301,20 +394,15 @@ struct Scanner {
   // *span_len = SIZE_MAX.  Returns false on invalid string syntax.
   bool read_string_span(const char** span, size_t* span_len,
                         std::string* decoded) {
-    const char* q = p + 1;
-    while (q < end) {
-      unsigned char c = static_cast<unsigned char>(*q);
-      if (c == '"') {
-        *span = p + 1;
-        *span_len = static_cast<size_t>(q - (p + 1));
-        p = q + 1;
-        return true;
-      }
-      if (c == '\\' || c < 0x20) break;
-      q++;
-    }
+    const char* q = scan_plain(p + 1, end);
     if (q >= end) return false;
-    if (static_cast<unsigned char>(*q) < 0x20 && *q != '\\') return false;
+    if (*q == '"') {
+      *span = p + 1;
+      *span_len = static_cast<size_t>(q - (p + 1));
+      p = q + 1;
+      return true;
+    }
+    if (static_cast<unsigned char>(*q) < 0x20) return false;
     *span_len = static_cast<size_t>(-1);
     return read_string(decoded);
   }
@@ -582,12 +670,15 @@ bool parse_object(Parser* pr, Scanner* sc, const TrieNode* node,
           const char* vspan;
           size_t vlen;
           if (!sc->read_string_span(&vspan, &vlen, &sval)) return false;
-          if (vlen != static_cast<size_t>(-1)) sval.assign(vspan, vlen);
+          if (vlen == static_cast<size_t>(-1)) {
+            vspan = sval.data();
+            vlen = sval.size();
+          }
           f.tags[i] = TAG_STRING;
-          f.strcodes[i] = f.dict.code(sval);
+          f.strcodes[i] = f.dict.code_span(vspan, vlen);
           if (f.date_hint) {
             int64_t ms;
-            if (parse_iso_date(sval.data(), sval.size(), &ms)) {
+            if (parse_iso_date(vspan, vlen, &ms)) {
               f.dateerr[i] = DATE_OK;
               // JS Math.floor(ms/1000)
               double d = static_cast<double>(ms);
@@ -602,8 +693,8 @@ bool parse_object(Parser* pr, Scanner* sc, const TrieNode* node,
           const char* vstart = sc->p;
           if (!sc->skip_value()) return false;
           f.tags[i] = TAG_ARRAY;
-          f.strcodes[i] = f.dict.code(
-              std::string(vstart, sc->p - vstart));
+          f.strcodes[i] = f.dict.code_span(
+              vstart, static_cast<size_t>(sc->p - vstart));
           if (f.date_hint) f.dateerr[i] = DATE_BAD;
         } else if (c == '{') {
           if (child->children.empty()) {
@@ -699,6 +790,7 @@ void build_trie(Parser* pr) {
 }
 
 void fill_subtree_fields(TrieNode* node) {
+  node->build_first_map();
   if (node->field >= 0) {
     node->subtree_fields.emplace_back(node->field, node->prio);
   }
