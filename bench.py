@@ -38,7 +38,7 @@ QUERY = {
 }
 
 
-def gen_records(n):
+def _mktestdata():
     import importlib.util
     import importlib.machinery
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -48,6 +48,54 @@ def gen_records(n):
                                                   loader=loader)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def gen_to_file(n, path):
+    """Write n generated records to path; native generator
+    (native/dngen.cc, same shape/distributions as tools/mktestdata)
+    when available, Python otherwise."""
+    mod = _mktestdata()
+    mindate_ms = int(mod.MINDATE.timestamp() * 1000)
+    maxdate_ms = int(mod.MAXDATE.timestamp() * 1000)
+
+    lib = None
+    if os.environ.get('DN_NATIVE', '1') != '0':
+        import ctypes
+        from dragnet_tpu import native as mod_native
+        so = os.path.join(mod_native._NATIVE_DIR, 'build',
+                          'libdngen.so')
+        if mod_native._build_target(
+                so, os.path.join(mod_native._NATIVE_DIR, 'dngen.cc')):
+            try:
+                lib = ctypes.CDLL(so)
+                lib.dn_gen.restype = ctypes.c_int64
+                lib.dn_gen.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_uint64]
+            except OSError:
+                lib = None
+
+    with open(path, 'wb') as f:
+        if lib is not None:
+            chunk = 200000
+            buf = ctypes.create_string_buffer(chunk * 512)
+            for start in range(0, n, chunk):
+                cnt = min(chunk, n - start)
+                nb = lib.dn_gen(buf, len(buf), start, cnt, n,
+                                mindate_ms, maxdate_ms, 12345)
+                if nb <= 0:
+                    raise RuntimeError('dn_gen failed (rv=%d)' % nb)
+                f.write(ctypes.string_at(buf, nb))
+        else:
+            for line in gen_records(n):
+                f.write(line.encode() + b'\n')
+
+
+def gen_records(n):
+    """All n records as JSON lines in memory (Python generator)."""
+    mod = _mktestdata()
     mindate_ms = int(mod.MINDATE.timestamp() * 1000)
     maxdate_ms = int(mod.MAXDATE.timestamp() * 1000)
     lines = []
@@ -138,14 +186,13 @@ def main():
 
     import tempfile
 
-    t0 = time.time()
-    lines = gen_records(nrecords)
-    gen_s = time.time() - t0
-
     tmpdir = tempfile.mkdtemp(prefix='dn_bench_')
     datafile = os.path.join(tmpdir, 'bench.log')
-    with open(datafile, 'w') as f:
-        f.write('\n'.join(lines) + '\n')
+    t0 = time.time()
+    gen_to_file(nrecords, datafile)
+    gen_s = time.time() - t0
+    with open(datafile) as f:
+        lines = [f.readline().rstrip('\n') for _ in range(host_sample)]
 
     def q():
         return mod_query.query_load(QUERY)

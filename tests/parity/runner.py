@@ -58,7 +58,9 @@ class DnRunner(object):
         if os.environ.get('DN_PARITY_SUBPROCESS'):
             proc = subprocess.run(
                 [sys.executable, DN] + list(args),
-                input=stdin, stdout=subprocess.PIPE,
+                input=stdin.encode() if isinstance(stdin, str)
+                else stdin,
+                stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE, env=self.env())
             if check and proc.returncode != 0:
                 raise AssertionError(

@@ -124,3 +124,27 @@ def test_jax_kernel_matches_numpy():
         os.environ['DN_ENGINE'] = 'auto'
     np_points, _ = run_vector(q2, records, weights, None, batch=256)
     assert sorted(map(repr, jax_points)) == sorted(map(repr, np_points))
+
+
+def test_sparse_merge_cardinality_overflow(monkeypatch):
+    """When the composite key space exceeds the dense-accumulator
+    budget, the engine spills to per-record hash aggregation
+    (engine._sparse_merge) with identical results and emission order."""
+    from dragnet_tpu import engine as mod_engine
+    monkeypatch.setattr(mod_engine, 'MAX_DENSE_SEGMENTS', 64)
+
+    rng = random.Random(7)
+    records = []
+    for i in range(1000):
+        records.append({'host': 'h%d' % rng.randrange(30),
+                        'req': {'method': 'm%d' % rng.randrange(30)},
+                        'latency': rng.randrange(1, 100)})
+    weights = [1] * len(records)
+
+    qspec = {'breakdowns': [{'name': 'host'}, {'name': 'req.method'}]}
+    host_points, _ = run_host(
+        mod_query.query_load(qspec), records, weights, None)
+    vec_points, _ = run_vector(
+        mod_query.query_load(qspec), records, weights, None)
+    assert host_points == vec_points
+    assert len(vec_points) > 64  # really exceeded the dense budget
