@@ -80,7 +80,7 @@ def gen_to_file(n, path):
     with open(path, 'wb') as f:
         if lib is not None:
             chunk = 200000
-            buf = ctypes.create_string_buffer(chunk * 512)
+            buf = ctypes.create_string_buffer(min(chunk, n) * 512)
             for start in range(0, n, chunk):
                 cnt = min(chunk, n - start)
                 nb = lib.dn_gen(buf, len(buf), start, cnt, n,

@@ -535,11 +535,13 @@ class DatasourceFile(object):
         """Feed the concatenated file bytes to the native parser,
         flushing a batch whenever enough records accumulate (partial
         trailing lines join across file boundaries — catstreams
-        semantics)."""
+        semantics).  The bulk of each read chunk is parsed in place
+        (zero-copy span); only the carry-spanning line is stitched."""
         # larger reads amortize the multithreaded parse's fork/join; the
         # cap bounds how far a batch can overshoot the flush threshold
         # (flush is only checked between reads)
         readsz = min(1 << 24, (1 << 22) * getattr(parser, 'nthreads', 1))
+        parse_at = getattr(parser, 'parse_at', None)
         carry = b''
         for path, st in files:
             with open(path, 'rb') as f:
@@ -547,13 +549,23 @@ class DatasourceFile(object):
                     chunk = f.read(readsz)
                     if not chunk:
                         break
-                    buf = carry + chunk
-                    nl = buf.rfind(b'\n')
+                    nl = chunk.rfind(b'\n')
                     if nl == -1:
-                        carry = buf
+                        carry += chunk
                         continue
-                    parser.parse(buf[:nl + 1])
-                    carry = buf[nl + 1:]
+                    if parse_at is None:
+                        parser.parse(carry + chunk[:nl + 1])
+                    else:
+                        start = 0
+                        if carry:
+                            first = chunk.index(b'\n', 0, nl + 1)
+                            parser.parse(carry + chunk[:first + 1])
+                            start = first + 1
+                        arr = np.frombuffer(chunk, dtype=np.uint8)
+                        if nl + 1 > start:
+                            parse_at(arr[start:].ctypes.data,
+                                     nl + 1 - start)
+                    carry = chunk[nl + 1:]
                     if parser.batch_size() >= batch_size:
                         flush()
         if carry:

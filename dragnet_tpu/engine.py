@@ -163,10 +163,34 @@ class NativeColumns(object):
                 out[m & (strcodes == v)] = leaf.outcome(arr)
         m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
         if m.any():
-            uniq, inv = np.unique(nums[m], return_inverse=True)
-            table = np.array([leaf.outcome(float(u)) for u in uniq],
-                             dtype=np.int8)
-            out[m] = table[inv]
+            const = leaf.const
+            if isinstance(const, bool) or \
+                    not isinstance(const, (int, float)):
+                # non-numeric constant: exact JS semantics per unique
+                uniq, inv = np.unique(nums[m], return_inverse=True)
+                table = np.array([leaf.outcome(float(u)) for u in uniq],
+                                 dtype=np.int8)
+                out[m] = table[inv]
+            else:
+                # number-vs-number compares are plain numeric compares
+                # in JS; vectorize directly (no unique/sort).  as_float
+                # maps ints beyond f64 range to +-inf like JS would.
+                const = jsv.as_float(const)
+                vals = nums[m]
+                op = leaf.op
+                if op == 'eq':
+                    hit = vals == const
+                elif op == 'ne':
+                    hit = vals != const
+                elif op == 'lt':
+                    hit = vals < const
+                elif op == 'le':
+                    hit = vals <= const
+                elif op == 'gt':
+                    hit = vals > const
+                else:
+                    hit = vals >= const
+                out[m] = np.where(hit, TRUE, FALSE).astype(np.int8)
         m = tags == mn.TAG_STRING
         if m.any():
             table = leaf.table_for(self.parser.dictionary(leaf.field))
@@ -206,6 +230,11 @@ class NativeColumns(object):
         dictionary codes."""
         mn = self.mn
         tags, nums, strcodes = self._field(path)
+        if (tags == mn.TAG_STRING).all():
+            # all-strings column (the usual case): one translated gather
+            trans = _native_str_trans(column,
+                                      self.parser.dictionary(path))
+            return trans[strcodes]
         out = np.empty(self.n, dtype=np.int64)
         code = column.dict.code
         out[tags == mn.TAG_MISSING] = code('undefined', 'undefined')

@@ -185,6 +185,17 @@ class NativeParser(object):
                                                self.nthreads)
         return self.lib.dn_parser_parse(self.h, buf, len(buf))
 
+    def parse_at(self, addr, length):
+        """parse() from a raw (address, length) span — the zero-copy
+        entry for parsing a slice of a read buffer without materializing
+        a bytes copy.  The caller must keep the backing buffer alive for
+        the duration of the call."""
+        addr = ctypes.c_char_p(addr)
+        if self.nthreads > 1:
+            return self.lib.dn_parser_parse_mt(self.h, addr, length,
+                                               self.nthreads)
+        return self.lib.dn_parser_parse(self.h, addr, length)
+
     def counters(self):
         return (self.lib.dn_parser_nlines(self.h),
                 self.lib.dn_parser_nbad(self.h))
