@@ -76,6 +76,11 @@ def number_to_string(v):
         if -(1 << 53) <= v <= (1 << 53):
             return str(v)
         v = as_float(v)
+    else:
+        # normalize numpy scalars (np.float64 subclasses float but its
+        # numpy-2.x repr() wraps the value in its type, breaking the
+        # shortest-round-trip logic below)
+        v = float(v)
     if math.isnan(v):
         return 'NaN'
     if math.isinf(v):

@@ -180,21 +180,19 @@ class NativeParser(object):
     def parse(self, buf):
         """Parse a bytes buffer of complete lines; returns the number of
         records appended to the current batch."""
-        if self.nthreads > 1:
-            return self.lib.dn_parser_parse_mt(self.h, buf, len(buf),
-                                               self.nthreads)
-        return self.lib.dn_parser_parse(self.h, buf, len(buf))
+        return self.parse_at(buf, len(buf))
 
-    def parse_at(self, addr, length):
-        """parse() from a raw (address, length) span — the zero-copy
+    def parse_at(self, buf, length):
+        """parse() from bytes or a raw integer address (the zero-copy
         entry for parsing a slice of a read buffer without materializing
-        a bytes copy.  The caller must keep the backing buffer alive for
-        the duration of the call."""
-        addr = ctypes.c_char_p(addr)
+        a copy).  With an address, the caller must keep the backing
+        buffer alive for the duration of the call."""
+        if isinstance(buf, int):
+            buf = ctypes.c_char_p(buf)
         if self.nthreads > 1:
-            return self.lib.dn_parser_parse_mt(self.h, addr, length,
+            return self.lib.dn_parser_parse_mt(self.h, buf, length,
                                                self.nthreads)
-        return self.lib.dn_parser_parse(self.h, addr, length)
+        return self.lib.dn_parser_parse(self.h, buf, length)
 
     def counters(self):
         return (self.lib.dn_parser_nlines(self.h),

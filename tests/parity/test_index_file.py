@@ -13,7 +13,9 @@ pytestmark = pytest.mark.skipif(not have_reference(),
 ONE_LOG = os.path.join(DATADIR, '2014', '05-01', 'one.log')
 
 
-def test_index_file(tmp_path):
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_index_file(tmp_path, index_format, monkeypatch):
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
     r = DnRunner(tmp_path)
     tmpfile = str(tmp_path / 'index_tree')
 

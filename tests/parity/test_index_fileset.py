@@ -11,7 +11,9 @@ pytestmark = pytest.mark.skipif(not have_reference(),
                                 reason='reference checkout not available')
 
 
-def test_index_fileset(tmp_path):
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_index_fileset(tmp_path, index_format, monkeypatch):
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
     r = DnRunner(tmp_path)
     tmpdir = str(tmp_path / 'index_tree')
 

@@ -18,7 +18,9 @@ pytestmark = pytest.mark.skipif(not have_reference(),
                                 reason='reference data not available')
 
 
-def test_index_scan_read_equals_build(tmp_path):
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_index_scan_read_equals_build(tmp_path, index_format, monkeypatch):
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
     r = DnRunner(tmp_path)
     idx_direct = str(tmp_path / 'idx_direct')
     idx_via = str(tmp_path / 'idx_via')
@@ -59,7 +61,9 @@ def test_index_scan_read_equals_build(tmp_path):
         assert got == want, args
 
 
-def test_index_config_roundtrip(tmp_path):
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_index_config_roundtrip(tmp_path, index_format, monkeypatch):
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
     """--index-config overrides configured metrics (the mechanism the
     distributed build uses to ship metric definitions to workers)."""
     r = DnRunner(tmp_path)
