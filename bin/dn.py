@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""dn: dragnet-tpu command-line interface."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from dragnet_tpu.cli import main  # noqa: E402
+
+# Lone surrogates (JSON \uD800-class escapes) must render rather than
+# crash; Node's utf-8 encoder emits U+FFFD for them (not '?', which is
+# what errors='replace' would produce).
+import codecs  # noqa: E402
+
+
+def _dn_fffd(err):
+    # U+FFFD when the stream encoding can take it; '?' otherwise
+    # (ASCII/C-locale stdout cannot encode the replacement char itself)
+    rep = '�'
+    try:
+        rep.encode(err.encoding)
+    except Exception:
+        rep = '?'
+    return (rep * (err.end - err.start), err.end)
+
+
+codecs.register_error('dn_fffd', _dn_fffd)
+for _stream in (sys.stdout, sys.stderr):
+    try:
+        _stream.reconfigure(errors='dn_fffd')
+    except Exception:
+        pass
+
+if __name__ == '__main__':
+    try:
+        rv = main()
+    except KeyboardInterrupt:
+        rv = 130
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except BrokenPipeError:
+        os._exit(0)
+    sys.exit(rv)

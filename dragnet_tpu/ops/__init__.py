@@ -22,7 +22,10 @@ _jax = None
 
 def get_jax():
     """Import jax on demand with x64 enabled; returns (jax, jnp) or None
-    if jax is unavailable."""
+    if jax is unavailable.  Deliberately does NOT touch the backend:
+    multi-process launches must call jax.distributed.initialize before
+    any backend-initializing call.  Callers that need live devices use
+    backend_ready() for a graceful host fallback."""
     global _jax
     if _jax is None:
         try:
@@ -33,3 +36,25 @@ def get_jax():
         except Exception:
             _jax = False
     return _jax if _jax else None
+
+
+_backend_ready = None
+
+
+def backend_ready():
+    """True when jax's platform actually initializes (e.g. False when a
+    device plugin's site hook was skipped under CLI fast start but
+    JAX_PLATFORMS still names it) — the gate for device execution paths
+    to degrade to the host engine instead of crashing."""
+    global _backend_ready
+    if _backend_ready is None:
+        j = get_jax()
+        if j is None:
+            _backend_ready = False
+        else:
+            try:
+                j[0].devices()
+                _backend_ready = True
+            except Exception:
+                _backend_ready = False
+    return _backend_ready

@@ -28,9 +28,22 @@ from . import distributed as mod_dist
 class MeshVectorScan(VectorScan):
     """VectorScan whose dense aggregation runs sharded over the mesh."""
 
+    _warned_no_backend = False
+
     def _dense_aggregate(self, key_codes, radices, weights, alive, n):
-        from ..ops import get_jax
-        if get_jax() is None:
+        from ..ops import backend_ready
+        if not backend_ready():
+            # no usable devices (jax missing, or its platform skipped
+            # under CLI fast start): host aggregation, same results —
+            # but say so once, or the degradation is invisible
+            if not MeshVectorScan._warned_no_backend:
+                MeshVectorScan._warned_no_backend = True
+                import sys
+                sys.stderr.write(
+                    'dn: warning: no usable accelerator backend; '
+                    'cluster aggregation running on host (set '
+                    'DN_FAST_START=0 if a site hook registers the '
+                    'device platform)\n')
             return super(MeshVectorScan, self)._dense_aggregate(
                 key_codes, radices, weights, alive, n)
         codes = np.stack(key_codes)

@@ -56,12 +56,14 @@ class DnRunner(object):
         DN_PARITY_SUBPROCESS=1 to exercise the real executable.
         """
         if os.environ.get('DN_PARITY_SUBPROCESS'):
+            env = self.env()
+            env['PYTHON'] = sys.executable
             proc = subprocess.run(
-                [sys.executable, DN] + list(args),
+                [DN] + list(args),
                 input=stdin.encode() if isinstance(stdin, str)
                 else stdin,
                 stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE, env=self.env())
+                stderr=subprocess.PIPE, env=env)
             if check and proc.returncode != 0:
                 raise AssertionError(
                     'dn %r exited %d:\n%s' % (args, proc.returncode,

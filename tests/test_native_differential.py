@@ -113,9 +113,14 @@ QUERIES = [
 ]
 
 
-def _scan(monkeypatch, datafile, qconf, native, threads='0'):
+def _scan(monkeypatch, datafile, qconf, native, threads='0',
+          parse_threads='1'):
     monkeypatch.setenv('DN_NATIVE', native)
     monkeypatch.setenv('DN_SCAN_THREADS', threads)
+    # pin the parser's threading so both its single-threaded path and
+    # the multithreaded deterministic merge are exercised regardless of
+    # the host's core count
+    monkeypatch.setenv('DN_PARSE_THREADS', parse_threads)
     ds = DatasourceFile({
         'ds_backend': 'file',
         'ds_backend_config': {'path': datafile,
@@ -141,7 +146,8 @@ def test_native_matches_python(tmp_path, monkeypatch, qi):
                                      native='1')
     assert py_points == nat_points, qconf
     mt_points, mt_counters = _scan(monkeypatch, datafile, qconf,
-                                   native='1', threads='3')
+                                   native='1', threads='3',
+                                   parse_threads='4')
     assert py_points == mt_points, qconf
     # counters must agree between all paths (stage names may differ in
     # layout but the parse-level invalid count must match)

@@ -1,0 +1,58 @@
+"""CLI fast start: shadow expensive site-customization hooks.
+
+Some deployment environments install a ``sitecustomize`` that imports a
+heavyweight accelerator runtime at interpreter start, adding seconds to
+every ``dn`` invocation (the reference project called out exactly this
+kind of startup cost, reference README.md:742-747).  ``bin/dn`` puts
+this directory first on PYTHONPATH so that THIS module is the one
+``site`` imports.
+
+When the command actually needs device backends — ``DN_ENGINE=jax``,
+a multi-process launch (``DN_COORDINATOR``), or fast start disabled via
+``DN_FAST_START=0`` — the real ``sitecustomize`` found later on
+``sys.path`` is loaded so accelerator registration still happens.
+Otherwise interpreter start stays light; if a scan later reaches for
+jax anyway, ``dragnet_tpu.ops.get_jax`` degrades to the host engine
+(correct results, no device acceleration).
+"""
+
+import os
+
+
+def _needs_real_site():
+    if os.environ.get('DN_FAST_START', '1') == '0':
+        return True
+    if os.environ.get('DN_ENGINE') == 'jax':
+        return True
+    if os.environ.get('DN_COORDINATOR'):
+        return True
+    return False
+
+
+def _chain():
+    import importlib.util
+    import sys
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in sys.path:
+        if not p:
+            continue
+        if os.path.abspath(p) == here:
+            continue
+        f = os.path.join(p, 'sitecustomize.py')
+        if os.path.exists(f):
+            spec = importlib.util.spec_from_file_location(
+                'sitecustomize_chained', f)
+            mod = importlib.util.module_from_spec(spec)
+            try:
+                spec.loader.exec_module(mod)
+            except Exception:
+                # match CPython's execsitecustomize: report, continue
+                import traceback
+                sys.stderr.write('Error in chained sitecustomize '
+                                 '(%s):\n' % f)
+                traceback.print_exc()
+            return
+
+
+if _needs_real_site():
+    _chain()
