@@ -208,9 +208,11 @@ class NativeColumns(object):
         """(dict_code, parsed_value) for array-tagged entries of this
         field's dictionary (raw JSON text interned by the parser).
         Cached on the parser keyed by dictionary length (the dictionary
-        is append-only).  The raw text passed the parser's strict JSON
-        validation, so json.loads cannot fail here — a failure would
-        mean native/fallback divergence and should be loud."""
+        is append-only).  The dictionary is shared with plain string
+        values, so a '['-prefixed entry may be a string that is not
+        valid JSON — those are skipped (an entry referenced by an
+        array-tagged row always parses, having passed the parser's
+        strict validation)."""
         import json
         d = self.parser.dictionary(path)
         cache = getattr(self.parser, '_array_cache', None)
@@ -218,9 +220,19 @@ class NativeColumns(object):
             cache = {}
             self.parser._array_cache = cache
         cached = cache.get(path)
-        if cached is None or cached[0] < len(d):
-            out = [(i, json.loads(raw)) for i, raw in enumerate(d)
-                   if raw.startswith('[')]
+        if cached is None:
+            cached = (0, [])
+        if cached[0] < len(d):
+            # append-only dictionary: parse only the new entries
+            out = cached[1]
+            for i in range(cached[0], len(d)):
+                raw = d[i]
+                if not raw.startswith('['):
+                    continue
+                try:
+                    out.append((i, json.loads(raw)))
+                except ValueError:
+                    pass  # a string value, not interned array text
             cached = (len(d), out)
             cache[path] = cached
         return cached[1]
@@ -245,9 +257,17 @@ class NativeColumns(object):
                                           '[object Object]')
         m = tags == mn.TAG_ARRAY
         if m.any():
+            out[m] = -1  # sentinel: every array row must be covered
             for v, arr in self._array_values(path):
                 s = jsv.to_string(arr)
                 out[m & (strcodes == v)] = code(s, s)
+            if (out[m] == -1).any():
+                # an array-tagged row whose dict entry did not parse
+                # would mean native/fallback divergence; fail loudly
+                # rather than aggregate uninitialized codes
+                raise RuntimeError(
+                    'native parser: array-tagged row with unparseable '
+                    'dictionary entry (field %r)' % path)
         m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
         if m.any():
             tagm = tags[m]

@@ -1,0 +1,142 @@
+"""Seeded random fuzz: native parser vs Python ingest over generated
+JSON.
+
+Complements the fixed adversarial corpus (test_native_differential) with
+structured random inputs: random nesting, random unicode (including
+astral and combining characters), random numbers across the double
+range, random value types in projected positions, random line
+corruption.  Seeded, so failures reproduce."""
+
+import json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import native as mod_native  # noqa: E402
+from dragnet_tpu import query as mod_query  # noqa: E402
+from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
+
+pytestmark = pytest.mark.skipif(mod_native.get_lib() is None,
+                                reason='native parser unavailable')
+
+
+def _rand_string(rng):
+    n = rng.randrange(0, 12)
+    chars = []
+    for _ in range(n):
+        r = rng.random()
+        if r < 0.5:
+            chars.append(chr(rng.randrange(32, 127)))
+        elif r < 0.7:
+            chars.append(chr(rng.randrange(0xA0, 0x2000)))
+        elif r < 0.85:
+            chars.append(chr(rng.randrange(0x1F300, 0x1F700)))
+        else:
+            chars.append(rng.choice('"\\\n\t\x7fé́'))
+    return ''.join(chars)
+
+
+def _rand_number(rng):
+    r = rng.random()
+    if r < 0.4:
+        return rng.randrange(-10 ** 6, 10 ** 6)
+    if r < 0.55:
+        return rng.randrange(-(1 << 60), 1 << 60)
+    if r < 0.8:
+        return rng.uniform(-1e6, 1e6)
+    return rng.choice([0, -1, 1e-300, 1e300, 5e-324, 2 ** 53,
+                       2 ** 53 + 2, 0.1, -0.0])
+
+
+def _rand_value(rng, depth=0):
+    r = rng.random()
+    if r < 0.3:
+        return _rand_string(rng)
+    if r < 0.55:
+        return _rand_number(rng)
+    if r < 0.63:
+        return rng.choice([True, False, None])
+    if r < 0.8 or depth >= 2:
+        return [_rand_value(rng, depth + 1)
+                for _ in range(rng.randrange(0, 3))]
+    return {_rand_string(rng) or 'k': _rand_value(rng, depth + 1)
+            for _ in range(rng.randrange(0, 3))}
+
+
+def _rand_record(rng):
+    rec = {}
+    if rng.random() < 0.9:
+        rec['host'] = _rand_value(rng)
+    if rng.random() < 0.8:
+        rec['req'] = {}
+        if rng.random() < 0.9:
+            rec['req']['method'] = rng.choice(
+                ['GET', 'PUT', _rand_string(rng), rng.randrange(100)])
+    if rng.random() < 0.3:
+        rec['req.method'] = _rand_string(rng)  # dotted direct key
+    if rng.random() < 0.9:
+        rec['latency'] = rng.choice(
+            [rng.randrange(0, 5000), rng.uniform(0, 100),
+             str(rng.randrange(100)), _rand_string(rng), None])
+    if rng.random() < 0.8:
+        rec['time'] = rng.choice([
+            '2014-05-%02dT%02d:00:00Z' % (rng.randrange(1, 28),
+                                          rng.randrange(24)),
+            rng.randrange(1, 2 ** 31),
+            _rand_string(rng),
+        ])
+    # decoys the projection must skip over
+    for _ in range(rng.randrange(0, 4)):
+        rec[_rand_string(rng) or 'pad'] = _rand_value(rng)
+    return rec
+
+
+QUERIES = [
+    {'breakdowns': [{'name': 'host'}]},
+    {'breakdowns': [{'name': 'req.method'},
+                    {'name': 'latency', 'aggr': 'quantize'}]},
+    {'filter': {'gt': ['latency', 50]},
+     'breakdowns': [{'name': 'host'}]},
+    {'timeAfter': '2014-05-05', 'timeBefore': '2014-05-20',
+     'breakdowns': [{'name': 'host'}]},
+]
+
+
+def _scan(monkeypatch, datafile, qconf, native):
+    monkeypatch.setenv('DN_NATIVE', native)
+    monkeypatch.setenv('DN_SCAN_THREADS', '2' if native == '1' else '0')
+    monkeypatch.setenv('DN_PARSE_THREADS', '3')
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile, 'timeField': 'time'},
+        'ds_filter': None,
+        'ds_format': 'json',
+    })
+    return ds.scan(mod_query.query_load(dict(qconf))).points
+
+
+@pytest.mark.parametrize('seed', [1, 2, 3, 4, 5])
+def test_fuzz_native_matches_python(tmp_path, monkeypatch, seed):
+    rng = random.Random(seed)
+    datafile = str(tmp_path / 'fuzz.log')
+    with open(datafile, 'w') as f:
+        for i in range(800):
+            # randomize escaping so both the \\uXXXX decode path and
+            # raw multi-byte UTF-8 reach the native parser
+            line = json.dumps(_rand_record(rng),
+                              separators=(',', ':'),
+                              ensure_ascii=rng.random() < 0.5)
+            if rng.random() < 0.05:
+                # corrupt the line (truncate / splice garbage)
+                cut = rng.randrange(0, len(line))
+                line = line[:cut] + rng.choice(['', '}', 'x', '\\'])
+            f.write(line + '\n')
+    for qconf in QUERIES:
+        py = _scan(monkeypatch, datafile, qconf, native='0')
+        nat = _scan(monkeypatch, datafile, qconf, native='1')
+        assert py == nat, (seed, qconf)

@@ -31,27 +31,25 @@ def _needs_real_site():
 
 def _chain():
     import importlib.util
+    from importlib.machinery import PathFinder
     import sys
     here = os.path.dirname(os.path.abspath(__file__))
-    for p in sys.path:
-        if not p:
-            continue
-        if os.path.abspath(p) == here:
-            continue
-        f = os.path.join(p, 'sitecustomize.py')
-        if os.path.exists(f):
-            spec = importlib.util.spec_from_file_location(
-                'sitecustomize_chained', f)
-            mod = importlib.util.module_from_spec(spec)
-            try:
-                spec.loader.exec_module(mod)
-            except Exception:
-                # match CPython's execsitecustomize: report, continue
-                import traceback
-                sys.stderr.write('Error in chained sitecustomize '
-                                 '(%s):\n' % f)
-                traceback.print_exc()
-            return
+    search = [p for p in sys.path
+              if p and os.path.abspath(p) != here]
+    # find_spec handles every importable form (module, package,
+    # compiled-only), not just a literal sitecustomize.py file
+    spec = PathFinder.find_spec('sitecustomize', search)
+    if spec is None or spec.loader is None:
+        return
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        # match CPython's execsitecustomize: report, continue
+        import traceback
+        sys.stderr.write('Error in chained sitecustomize (%s):\n'
+                         % (spec.origin or spec.name))
+        traceback.print_exc()
 
 
 if _needs_real_site():
