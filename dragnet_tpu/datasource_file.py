@@ -190,14 +190,17 @@ class DatasourceFile(object):
         skinner = fmt == 'json-skinner'
         proj = scanner.projection()
         if skinner:
-            paths = ['fields.' + p for p, h in proj] + ['value']
-            hints = [h for p, h in proj] + [False]
+            paths = ['fields.' + p for p, h, d in proj] + ['value']
+            hints = [h for p, h, d in proj] + [False]
+            dicts = [d for p, h, d in proj] + [True]
         else:
-            paths = [p for p, h in proj]
-            hints = [h for p, h in proj]
+            paths = [p for p, h, d in proj]
+            hints = [h for p, h, d in proj]
+            dicts = [d for p, h, d in proj]
         parser = mod_native.NativeParser(paths, hints)
         remap = {p: np_ for p, np_ in
-                 zip([p for p, h in proj], paths)} if skinner else None
+                 zip([p for p, h, d in proj], paths)} if skinner \
+            else None
 
         nworkers = scan_mt.scan_threads()
         use_mt = nworkers > 0 and scan_cls is VectorScan
@@ -231,7 +234,8 @@ class DatasourceFile(object):
                 n = parser.batch_size()
                 if n == 0:
                     return
-                snap = scan_mt.ParserSnapshot(parser, paths, hints)
+                snap = scan_mt.ParserSnapshot(parser, paths, hints,
+                                              dicts)
                 parser.reset_batch()
                 _bump_parse_counters(parser_stage, adapter_stage,
                                      snap.nlines, snap.nbad, n)
@@ -422,20 +426,24 @@ class DatasourceFile(object):
         proj = {}
         if filter is not None:
             for f in holder.filter_fields:
-                proj.setdefault(f, False)
+                proj.setdefault(f, [False, True])
         for s in scanners:
-            for p, h in s.projection():
-                proj[p] = proj.get(p, False) or h
+            for p, h, d in s.projection():
+                ent = proj.setdefault(p, [False, False])
+                ent[0] = ent[0] or h
+                ent[1] = ent[1] or d
 
         items = list(proj.items())
         if skinner:
-            paths = ['fields.' + p for p, h in items] + ['value']
-            hints = [h for p, h in items] + [False]
+            paths = ['fields.' + p for p, hd in items] + ['value']
+            hints = [hd[0] for p, hd in items] + [False]
+            dicts = [hd[1] for p, hd in items] + [True]
         else:
-            paths = [p for p, h in items]
-            hints = [h for p, h in items]
+            paths = [p for p, hd in items]
+            hints = [hd[0] for p, hd in items]
+            dicts = [hd[1] for p, hd in items]
         parser = mod_native.NativeParser(paths, hints)
-        remap = {p: np_ for (p, h), np_ in zip(items, paths)} \
+        remap = {p: np_ for (p, hd), np_ in zip(items, paths)} \
             if skinner else None
 
         def eval_ds_filter(pred, stage, provider, n):
@@ -491,7 +499,8 @@ class DatasourceFile(object):
                 n = parser.batch_size()
                 if n == 0:
                     return
-                snap = scan_mt.ParserSnapshot(parser, paths, hints)
+                snap = scan_mt.ParserSnapshot(parser, paths, hints,
+                                              dicts)
                 parser.reset_batch()
                 _bump_parse_counters(parser_stage, adapter_stage,
                                      snap.nlines, snap.nbad, n)

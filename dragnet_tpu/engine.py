@@ -159,8 +159,17 @@ class NativeColumns(object):
         out[tags == mn.TAG_OBJECT] = leaf.outcome({})
         m = tags == mn.TAG_ARRAY
         if m.any():
+            covered = np.zeros(self.n, dtype=bool)
             for v, arr in self._array_values(leaf.field):
-                out[m & (strcodes == v)] = leaf.outcome(arr)
+                hit = m & (strcodes == v)
+                out[hit] = leaf.outcome(arr)
+                covered |= hit
+            if not covered[m].all():
+                # same loud-divergence contract as string_codes: an
+                # array-tagged row must decode from the dictionary
+                raise RuntimeError(
+                    'native parser: array-tagged row with unparseable '
+                    'dictionary entry (field %r)' % leaf.field)
         m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
         if m.any():
             const = leaf.const
@@ -433,18 +442,26 @@ class VectorScan(object):
     # -- projection (what the native parser must extract) -----------------
 
     def projection(self):
-        """[(path, date_hint)] of every field the scan reads from raw
-        records."""
-        paths = {}
+        """[(path, date_hint, need_dict)] of every field the scan reads
+        from raw records.  need_dict marks paths whose per-field string
+        dictionary the engine may read (filter leaves, breakdown
+        columns); date-only sources are consumed via the pre-parsed
+        date columns and their dictionaries — potentially one entry per
+        record for timestamp fields — must not be materialized."""
+        date = {}
+        need_dict = {}
         for f in self.filter_fields:
-            paths.setdefault(f, False)
+            date.setdefault(f, False)
+            need_dict[f] = True
         for fieldconf in self.synthetic:
-            paths[fieldconf['field']] = True
+            date[fieldconf['field']] = True
+            need_dict.setdefault(fieldconf['field'], False)
         for b in self.query.qc_breakdowns:
             synth = any(s['name'] == b['name'] for s in self.synthetic)
             if not synth:
-                paths.setdefault(b['name'], False)
-        return list(paths.items())
+                date.setdefault(b['name'], False)
+                need_dict[b['name']] = True
+        return [(p, date[p], need_dict[p]) for p in date]
 
     # -- provider helpers --------------------------------------------------
 

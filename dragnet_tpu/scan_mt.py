@@ -74,17 +74,25 @@ class ParserSnapshot(object):
     while the main thread keeps parsing.  Column arrays are fresh copies
     (NativeParser.columns copies out of the C buffers); dictionaries are
     length-pinned views of the parser's append-only Python mirrors —
-    codes in this batch only reference entries below the pin."""
+    codes in this batch only reference entries below the pin.
 
-    def __init__(self, parser, paths, hints):
+    need_dicts marks the paths whose dictionary the engine may read;
+    date-only sources are consumed via the pre-parsed date columns, and
+    mirroring their dictionaries (one entry per distinct timestamp —
+    nearly one per record) would dominate the whole scan."""
+
+    def __init__(self, parser, paths, hints, need_dicts=None):
+        if need_dicts is None:
+            need_dicts = [True] * len(paths)
         self._n = parser.batch_size()
         self._cols = {}
         self._dates = {}
         self._dicts = {}
-        for p, h in zip(paths, hints):
-            self._cols[p] = parser.columns(p)
-            d = parser.dictionary(p)
-            self._dicts[p] = PinnedList(d, len(d))
+        for p, h, nd in zip(paths, hints, need_dicts):
+            if nd:
+                self._cols[p] = parser.columns(p)
+                d = parser.dictionary(p)
+                self._dicts[p] = PinnedList(d, len(d))
             if h:
                 self._dates[p] = parser.date_columns(p)
         self.nlines, self.nbad = parser.counters()

@@ -42,6 +42,10 @@ def _chain():
     if spec is None or spec.loader is None:
         return
     mod = importlib.util.module_from_spec(spec)
+    # replace this shim in sys.modules so package-relative imports
+    # inside the chained module resolve against it (Python honors
+    # self-replacement during module execution)
+    sys.modules['sitecustomize'] = mod
     try:
         spec.loader.exec_module(mod)
     except Exception:
