@@ -51,13 +51,16 @@ def _mktestdata():
     return mod
 
 
-def gen_to_file(n, path):
+def gen_to_file(n, path, mindate_ms=None, maxdate_ms=None):
     """Write n generated records to path; native generator
     (native/dngen.cc, same shape/distributions as tools/mktestdata)
-    when available, Python otherwise."""
+    when available, Python otherwise.  Timestamps increase linearly
+    over [mindate_ms, maxdate_ms) (default: mktestdata's window)."""
     mod = _mktestdata()
-    mindate_ms = int(mod.MINDATE.timestamp() * 1000)
-    maxdate_ms = int(mod.MAXDATE.timestamp() * 1000)
+    if mindate_ms is None:
+        mindate_ms = int(mod.MINDATE.timestamp() * 1000)
+    if maxdate_ms is None:
+        maxdate_ms = int(mod.MAXDATE.timestamp() * 1000)
 
     lib = None
     if os.environ.get('DN_NATIVE', '1') != '0':
@@ -89,21 +92,10 @@ def gen_to_file(n, path):
                     raise RuntimeError('dn_gen failed (rv=%d)' % nb)
                 f.write(ctypes.string_at(buf, nb))
         else:
-            for line in gen_records(n):
-                f.write(line.encode() + b'\n')
-
-
-def gen_records(n):
-    """All n records as JSON lines in memory (Python generator)."""
-    mod = _mktestdata()
-    mindate_ms = int(mod.MINDATE.timestamp() * 1000)
-    maxdate_ms = int(mod.MAXDATE.timestamp() * 1000)
-    lines = []
-    for i in range(n):
-        lines.append(json.dumps(
-            mod.make_record(i, n, mindate_ms, maxdate_ms),
-            separators=(',', ':')))
-    return lines
+            for i in range(n):
+                f.write(json.dumps(
+                    mod.make_record(i, n, mindate_ms, maxdate_ms),
+                    separators=(',', ':')).encode() + b'\n')
 
 
 def run_scan(datafile, query):

@@ -197,7 +197,7 @@ class DatasourceFile(object):
             paths = [p for p, h, d in proj]
             hints = [h for p, h, d in proj]
             dicts = [d for p, h, d in proj]
-        parser = mod_native.NativeParser(paths, hints)
+        parser = mod_native.NativeParser(paths, hints, dicts)
         remap = {p: np_ for p, np_ in
                  zip([p for p, h, d in proj], paths)} if skinner \
             else None
@@ -442,7 +442,7 @@ class DatasourceFile(object):
             paths = [p for p, hd in items]
             hints = [hd[0] for p, hd in items]
             dicts = [hd[1] for p, hd in items]
-        parser = mod_native.NativeParser(paths, hints)
+        parser = mod_native.NativeParser(paths, hints, dicts)
         remap = {p: np_ for (p, hd), np_ in zip(items, paths)} \
             if skinner else None
 

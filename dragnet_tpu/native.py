@@ -98,6 +98,14 @@ def get_lib():
         lib.dn_parser_create.argtypes = [
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+        try:
+            lib.dn_parser_create2.restype = ctypes.c_void_p
+            lib.dn_parser_create2.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+        except AttributeError:
+            pass
         lib.dn_parser_destroy.argtypes = [ctypes.c_void_p]
         lib.dn_parser_parse.restype = ctypes.c_int64
         lib.dn_parser_parse.argtypes = [ctypes.c_void_p,
@@ -154,7 +162,7 @@ def parse_threads():
 class NativeParser(object):
     """One parser per scan: dictionaries persist across batches."""
 
-    def __init__(self, paths, date_hints):
+    def __init__(self, paths, date_hints, need_dicts=None):
         self.lib = get_lib()
         assert self.lib is not None
         self.nthreads = parse_threads()
@@ -165,7 +173,16 @@ class NativeParser(object):
             *[p.encode() for p in paths])
         hints = (ctypes.c_uint8 * len(paths))(
             *[1 if h else 0 for h in date_hints])
-        self.h = self.lib.dn_parser_create(arr, hints, len(paths))
+        if need_dicts is not None and \
+                hasattr(self.lib, 'dn_parser_create2'):
+            # date-only fields skip string interning entirely (their
+            # dictionaries would hold ~one entry per record)
+            dicts = (ctypes.c_uint8 * len(paths))(
+                *[1 if d else 0 for d in need_dicts])
+            self.h = self.lib.dn_parser_create2(arr, hints, dicts,
+                                                len(paths))
+        else:
+            self.h = self.lib.dn_parser_create(arr, hints, len(paths))
         self.field_index = {p: i for i, p in enumerate(paths)}
         # per-field python mirror of the native dictionary
         self._dicts = [[] for _ in paths]

@@ -110,6 +110,7 @@ struct FieldOut {
   std::vector<uint8_t> dateerr;   // only filled when date_hint
   StringDict dict;
   bool date_hint = false;
+  bool want_dict = true;
   // scratch per record: priority of the value currently held
   // (0 = none, 1 = nested match, 2 = direct full-key match)
   uint8_t cur_prio = 0;
@@ -675,7 +676,8 @@ bool parse_object(Parser* pr, Scanner* sc, const TrieNode* node,
             vlen = sval.size();
           }
           f.tags[i] = TAG_STRING;
-          f.strcodes[i] = f.dict.code_span(vspan, vlen);
+          f.strcodes[i] = f.want_dict
+              ? f.dict.code_span(vspan, vlen) : -1;
           if (f.date_hint) {
             int64_t ms;
             if (parse_iso_date(vspan, vlen, &ms)) {
@@ -693,8 +695,10 @@ bool parse_object(Parser* pr, Scanner* sc, const TrieNode* node,
           const char* vstart = sc->p;
           if (!sc->skip_value()) return false;
           f.tags[i] = TAG_ARRAY;
-          f.strcodes[i] = f.dict.code_span(
-              vstart, static_cast<size_t>(sc->p - vstart));
+          f.strcodes[i] = f.want_dict
+              ? f.dict.code_span(vstart,
+                                 static_cast<size_t>(sc->p - vstart))
+              : -1;
           if (f.date_hint) f.dateerr[i] = DATE_BAD;
         } else if (c == '{') {
           if (child->children.empty()) {
@@ -819,6 +823,20 @@ void* dn_parser_create(const char** paths, const uint8_t* date_hints,
   return pr;
 }
 
+// Variant with per-field dictionary control: want_dict[i] == 0 means
+// the engine never reads this field's string dictionary (date-only
+// sources, consumed via the pre-parsed date columns) — string/array
+// values then skip interning entirely (strcode -1), which for
+// timestamp-like fields saves a hash + heap string per record.
+void* dn_parser_create2(const char** paths, const uint8_t* date_hints,
+                        const uint8_t* want_dict, int32_t nfields) {
+  Parser* pr = static_cast<Parser*>(
+      dn_parser_create(paths, date_hints, nfields));
+  for (int32_t i = 0; i < nfields; i++)
+    pr->fields[i].want_dict = want_dict[i] != 0;
+  return pr;
+}
+
 void dn_parser_destroy(void* h) {
   delete static_cast<Parser*>(h);
 }
@@ -932,6 +950,7 @@ int64_t dn_parser_parse_mt(void* h, const char* buf, int64_t len,
     w->fields.resize(pr->fields.size());
     for (size_t i = 0; i < pr->fields.size(); i++) {
       w->fields[i].date_hint = pr->fields[i].date_hint;
+      w->fields[i].want_dict = pr->fields[i].want_dict;
     }
     w->trie = &pr->root;
     pr->workers.push_back(w);

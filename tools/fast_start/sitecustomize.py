@@ -45,15 +45,20 @@ def _chain():
     # replace this shim in sys.modules so package-relative imports
     # inside the chained module resolve against it (Python honors
     # self-replacement during module execution)
+    prev = sys.modules.get('sitecustomize')
     sys.modules['sitecustomize'] = mod
     try:
         spec.loader.exec_module(mod)
     except Exception:
-        # match CPython's execsitecustomize: report, continue
+        # match CPython: report, drop the half-initialized module
         import traceback
         sys.stderr.write('Error in chained sitecustomize (%s):\n'
                          % (spec.origin or spec.name))
         traceback.print_exc()
+        if prev is not None:
+            sys.modules['sitecustomize'] = prev
+        else:
+            sys.modules.pop('sitecustomize', None)
 
 
 if _needs_real_site():
