@@ -52,8 +52,11 @@ class Aggregator(object):
         self.decomps = [b['name'] for b in query.qc_breakdowns]
         self.bucketizers = query.qc_bucketizers
         self.stage = stage
-        # nested dict: level i keyed by decomp i's key; leaves are weights
-        self.root = {} if self.decomps else 0
+        # flat map: key tuple -> weight, insertion-ordered (Python
+        # dicts preserve it); the nested JS-object view is built once
+        # at walk time — one dict op per write instead of one per level
+        self.flat = {}
+        self.total = 0  # the no-decomposition case
         self.nrecords = 0
 
     def write(self, fields, value):
@@ -82,33 +85,42 @@ class Aggregator(object):
                 keys.append(self.bucketizers[name].bucketize(v))
             else:
                 keys.append(jsv.to_string(v))
-        self._add(keys, value)
+        self._add(tuple(keys), value)
 
     def write_key(self, keys, value):
         """Add a pre-computed key tuple (ordinals for bucketized fields,
         strings otherwise) — the entry point for the vectorized path."""
-        self._add(list(keys), value)
+        self._add(tuple(keys), value)
 
     def _add(self, keys, value):
         self.nrecords += 1
         if not self.decomps:
-            self.root += value
+            self.total += value
             return
-        node = self.root
-        for k in keys[:-1]:
-            nxt = node.get(k)
-            if nxt is None:
-                nxt = {}
-                node[k] = nxt
-            node = nxt
-        last = keys[-1]
-        node[last] = node.get(last, 0) + value
+        flat = self.flat
+        flat[keys] = flat.get(keys, 0) + value
 
     def _walk(self):
-        """Yield (keys_tuple, weight) in JS property-enumeration order."""
+        """Yield (keys_tuple, weight) in JS property-enumeration order.
+
+        The nested dict is materialized from the flat map here: each
+        level's key insertion order equals the first occurrence of any
+        tuple with that prefix, exactly as per-write nested insertion
+        produced."""
         if not self.decomps:
-            yield ((), self.root)
+            yield ((), self.total)
             return
+
+        root = {}
+        for keys, weight in self.flat.items():
+            node = root
+            for k in keys[:-1]:
+                nxt = node.get(k)
+                if nxt is None:
+                    nxt = {}
+                    node[k] = nxt
+                node = nxt
+            node[keys[-1]] = weight
 
         def rec(node, depth, prefix):
             if depth == len(self.decomps):
@@ -120,7 +132,7 @@ class Aggregator(object):
                     yield item
                 prefix.pop()
 
-        for item in rec(self.root, 0, []):
+        for item in rec(root, 0, []):
             yield item
 
     def points(self):

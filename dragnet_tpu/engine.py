@@ -589,8 +589,7 @@ class VectorScan(object):
         # Which keys occurred (including zero-weight ones — the host
         # reference emits those too), and in what order: inserting each
         # distinct tuple at its first-occurrence position makes the
-        # nested-dict walk reproduce the host path's emission order
-        # exactly.
+        # walk reproduce the host path's emission order exactly.
         fused_host = np.zeros(n, dtype=np.int64)
         for codes, r in zip(key_codes, radices):
             fused_host = fused_host * r + codes
@@ -604,21 +603,24 @@ class VectorScan(object):
             occurred = np.nonzero(first >= 0)[0]
             order = np.argsort(first[occurred], kind='stable')
             fused_order = occurred[order]
+            rows = first[occurred][order]
         else:
             # sparse key space: sort only the alive records
             uniq, first_idx = np.unique(fused_host[idx],
                                         return_index=True)
             order = np.argsort(first_idx, kind='stable')
             fused_order = uniq[order]
-        for fused in fused_order.tolist():
-            w = dense[fused]
-            key = []
-            f = fused
-            for r, dec in zip(reversed(radices), reversed(decoders)):
-                f, c = divmod(f, r)
-                key.append(dec[c])
-            key.reverse()
-            self.aggr.write_key(tuple(key), self._weight(w))
+            rows = idx[first_idx[order]]
+
+        # decode each unique's key from its first-occurrence row (no
+        # per-key divmod), then stream tuples into the aggregator
+        cols_vals = []
+        for codes, dec in zip(key_codes, decoders):
+            cols_vals.append([dec[c] for c in codes[rows].tolist()])
+        write_key = self.aggr.write_key
+        for keys, w in zip(zip(*cols_vals),
+                           dense[fused_order].tolist()):
+            write_key(keys, int(w) if w.is_integer() else w)
 
     def _weight(self, w):
         w = float(w)  # numpy scalar -> python (affects str() rendering)
