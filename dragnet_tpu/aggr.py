@@ -16,8 +16,8 @@ node-skinner, #dragnet branch) as used via queryAggrStream
   ascending first, then string keys in insertion order.
 
 This host-side implementation is the semantic reference; the vectorized
-device path (ops/aggregate.py) computes identical (key -> weight) maps for
-columnar batches and merges into the same nested structure.
+paths (engine.py and ops/kernels.py) compute identical (key -> weight)
+maps for columnar batches and merge into the same flat structure.
 """
 
 from . import jsvalues as jsv
@@ -140,7 +140,7 @@ class Aggregator(object):
         fields (re-ingestable), strings otherwise."""
         out = []
         if not self.decomps:
-            out.append(({}, self.root))
+            out.append(({}, self.total))
             if self.stage is not None:
                 self.stage.bump('noutputs')
             return out
@@ -161,7 +161,7 @@ class Aggregator(object):
         or a bare total when there are no decompositions (what the
         reference's SkinnerFlattener emits with resultsAsPoints:false)."""
         if not self.decomps:
-            return [self.root]
+            return [self.total]
         rv = []
         for keys, weight in self._walk():
             rv.append(list(keys) + [weight])

@@ -232,8 +232,12 @@ class NativeColumns(object):
         if cached is None:
             cached = (0, [])
         if cached[0] < len(d):
-            # append-only dictionary: parse only the new entries
-            out = cached[1]
+            # append-only dictionary: parse only the new entries.
+            # The cache dict is shared across scan_mt worker threads, so
+            # never mutate a stored list in place: extend a private copy
+            # and publish a fresh (len, list) tuple — concurrent racers
+            # may redo work, but every published tuple is consistent.
+            out = list(cached[1])
             for i in range(cached[0], len(d)):
                 raw = d[i]
                 if not raw.startswith('['):

@@ -556,7 +556,51 @@ class DncIndexQuerier(IndexQuerierBase):
             const = int(const)
         if not isinstance(const, (int, float)):
             return np.zeros(n, dtype=bool)
-        return self._cmp(op, arr, const)
+        if isinstance(const, float):
+            return self._mask_i64_float(arr, op, const, n)
+        if const > 2 ** 63 - 1:
+            return self._all_if(op in ('lt', 'le', 'ne'), n)
+        if const < -2 ** 63:
+            return self._all_if(op in ('gt', 'ge', 'ne'), n)
+        return self._cmp(op, arr, np.int64(const))
+
+    @staticmethod
+    def _all_if(cond, n):
+        return np.ones(n, dtype=bool) if cond else np.zeros(n, dtype=bool)
+
+    def _mask_i64_float(self, arr, op, const, n):
+        """Exact INTEGER-vs-REAL comparison.  SQLite compares the two
+        types exactly (sqlite3IntFloatCompare); numpy's implicit int64 ->
+        float64 promotion rounds values with |v| > 2^53, so integral
+        REALs compare as exact ints and non-integral REALs split into
+        floor/ceil integer comparisons."""
+        import math
+        if math.isnan(const):
+            # REAL NaN sorts before every INTEGER in SQLite
+            return self._all_if(op in ('gt', 'ge', 'ne'), n)
+        if math.isinf(const):
+            if const > 0:
+                return self._all_if(op in ('lt', 'le', 'ne'), n)
+            return self._all_if(op in ('gt', 'ge', 'ne'), n)
+        if const.is_integer():
+            ci = int(const)
+            if ci > 2 ** 63 - 1:
+                return self._all_if(op in ('lt', 'le', 'ne'), n)
+            if ci < -2 ** 63:
+                return self._all_if(op in ('gt', 'ge', 'ne'), n)
+            return self._cmp(op, arr, np.int64(ci))
+        if op == 'eq':
+            return np.zeros(n, dtype=bool)
+        if op == 'ne':
+            return np.ones(n, dtype=bool)
+        f = math.floor(const)  # v < const <=> v <= floor(const)
+        if f >= 2 ** 63 - 1:
+            return self._all_if(op in ('lt', 'le'), n)
+        if f < -2 ** 63:
+            return self._all_if(op in ('gt', 'ge'), n)
+        if op in ('lt', 'le'):
+            return arr <= np.int64(f)
+        return arr >= np.int64(f + 1)
 
     def _mask_str(self, c, t, op, const, n):
         codes = self._codes(c, t)

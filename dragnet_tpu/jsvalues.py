@@ -348,10 +348,18 @@ def json_parse(text):
     """
     import json as _json
     try:
-        return _json.loads(text)
+        return _json.loads(text, parse_constant=_reject_nonfinite)
     except _json.JSONDecodeError as e:
         msg = _v8_json_error(text, e)
         raise ValueError(msg)
+
+
+def _reject_nonfinite(name):
+    # Python's json accepts NaN/Infinity/-Infinity as an extension;
+    # JSON.parse does not, and downstream engines diverge on non-finite
+    # constants (SQL has no literal for them) — reject with the token
+    # V8's tokenizer would report.
+    raise ValueError('Unexpected token %s' % name.lstrip('-')[0])
 
 
 def _v8_json_error(text, e):

@@ -80,6 +80,11 @@ def _validate(pred, fields_out):
     if not (isinstance(value, str) or jsv.is_number(value) or
             isinstance(value, bool)):
         raise _err(pred, 'value must be a string, number, or boolean')
+    if isinstance(value, float) and not math.isfinite(value):
+        # unreachable through JSON (JSON.parse has no non-finite
+        # literals, and jsvalues.json_parse matches); guard the
+        # library path — SQL pushdown has no literal for these
+        raise _err(pred, 'value must be a finite number')
     if field not in fields_out:
         fields_out.append(field)
 

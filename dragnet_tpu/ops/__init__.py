@@ -4,9 +4,12 @@ The reference's per-record hot loop (JSON.parse -> predicate.eval ->
 Date.parse -> hash update, one JS callback round-trip per record per stage;
 see SURVEY.md §3.1) becomes, per columnar batch:
 
-* predicate -> 3-state mask fold (ops/predicate.py),
-* bucketize -> elementwise power-of-two / linear kernels (ops/bucketize.py),
-* group-by  -> mixed-radix key fusion + segment-sum (ops/aggregate.py).
+* predicate -> 3-state mask fold,
+* bucketize -> elementwise power-of-two / linear kernels,
+* group-by  -> mixed-radix key fusion + segment-sum,
+
+all in ops/kernels.py (jax.numpy, jit) with Pallas/Mosaic variants of the
+hot kernels in ops/pallas_kernels.py.
 
 Kernels are written against jax.numpy and jit-compiled (MXU/VPU on TPU;
 XLA:CPU in tests), with semantics pinned to the host reference

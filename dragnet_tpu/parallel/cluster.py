@@ -41,8 +41,8 @@ class MeshVectorScan(VectorScan):
                 import sys
                 sys.stderr.write(
                     'dn: warning: no usable accelerator backend; '
-                    'cluster aggregation running on host (set '
-                    'DN_FAST_START=0 if a site hook registers the '
+                    'cluster aggregation running on host (unset '
+                    'DN_FAST_START if a site hook registers the '
                     'device platform)\n')
             return super(MeshVectorScan, self)._dense_aggregate(
                 key_codes, radices, weights, alive, n)

@@ -303,3 +303,35 @@ def test_null_group_and_empty_sum(tmp_path):
         r1 = IndexQuerier(sq).run(q)
         r2 = DncIndexQuerier(dn).run(q)
         assert r1 == r2, qconf
+
+
+def test_int_vs_real_exact_comparison(tmp_path):
+    # SQLite compares INTEGER vs REAL exactly (sqlite3IntFloatCompare);
+    # numpy's implicit int64 -> float64 promotion would round values
+    # past 2^53 and diverge.  lquantize step=1 keeps the stored bucket
+    # values exact int64.
+    big = 2 ** 53  # 9007199254740992; big+1 is not float-representable
+    m = _metric('latency[aggr=lquantize;step=1]')
+    rows = [({'latency': v}, 1) for v in
+            (big - 1, big, big + 1, big + 2, -big - 1, 3)]
+    sq = str(tmp_path / 'sq.sqlite')
+    dn = str(tmp_path / 'dn.sqlite')
+    s1 = IndexSink([m], sq, config={'dn_start': 0})
+    s2 = DncIndexSink([m], dn, config={'dn_start': 0})
+    for f, v in _points(m, rows):
+        s1.write(f, v)
+        s2.write(f, v)
+    s1.flush()
+    s2.flush()
+    # (inf/nan are unreachable: filter constants arrive as JSON)
+    consts = [float(big), float(big) + 2.0, -float(big) - 2.0, 2.5,
+              float(2 ** 63), -float(2 ** 63), 3.0]
+    bd = [{'name': 'latency', 'aggr': 'lquantize', 'step': 1}]
+    for const in consts:
+        for op in ('eq', 'ne', 'lt', 'le', 'gt', 'ge'):
+            q = mod_query.query_load(
+                {'filter': {op: ['latency', const]}, 'breakdowns': bd})
+            assert not isinstance(q, DNError)
+            r1 = IndexQuerier(sq).run(q)
+            r2 = DncIndexQuerier(dn).run(q)
+            assert r1 == r2, (op, const)

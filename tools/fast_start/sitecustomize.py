@@ -1,26 +1,25 @@
-"""CLI fast start: shadow expensive site-customization hooks.
+"""CLI fast start: shadow expensive site-customization hooks (opt-in).
 
 Some deployment environments install a ``sitecustomize`` that imports a
 heavyweight accelerator runtime at interpreter start, adding seconds to
 every ``dn`` invocation (the reference project called out exactly this
-kind of startup cost, reference README.md:742-747).  ``bin/dn`` puts
-this directory first on PYTHONPATH so that THIS module is the one
-``site`` imports.
+kind of startup cost, reference README.md:742-747).  When the operator
+opts in with ``DN_FAST_START=1``, ``bin/dn`` puts this directory first
+on PYTHONPATH so that THIS module is the one ``site`` imports.
 
-When the command actually needs device backends — ``DN_ENGINE=jax``,
-a multi-process launch (``DN_COORDINATOR``), or fast start disabled via
-``DN_FAST_START=0`` — the real ``sitecustomize`` found later on
-``sys.path`` is loaded so accelerator registration still happens.
-Otherwise interpreter start stays light; if a scan later reaches for
-jax anyway, ``dragnet_tpu.ops.get_jax`` degrades to the host engine
-(correct results, no device acceleration).
+When the command actually needs device backends — ``DN_ENGINE=jax`` or
+a multi-process launch (``DN_COORDINATOR``) — the real
+``sitecustomize`` found later on ``sys.path`` is loaded so accelerator
+registration still happens.  Otherwise interpreter start stays light;
+if a scan later reaches for jax anyway, ``dragnet_tpu.ops.get_jax``
+degrades to the host engine (correct results, no device acceleration).
 """
 
 import os
 
 
 def _needs_real_site():
-    if os.environ.get('DN_FAST_START', '1') == '0':
+    if os.environ.get('DN_FAST_START', '0') != '1':
         return True
     if os.environ.get('DN_ENGINE') == 'jax':
         return True
