@@ -58,8 +58,8 @@ class DatasourceFile(object):
         pass
 
     def _vector_scan_cls(self):
-        from .engine import VectorScan
-        return VectorScan
+        from .device_scan import scan_class
+        return scan_class()
 
     # -- input enumeration ------------------------------------------------
 
@@ -165,6 +165,8 @@ class DatasourceFile(object):
                     value = int(value) if value.is_integer() else value
                 scanner.write(fields, value)
 
+        if hasattr(scanner, 'finish'):
+            scanner.finish()   # merge any device-buffered batches
         return ScanResult(pipeline, points=scanner.aggr.points(),
                           query=query)
 
@@ -371,6 +373,8 @@ class DatasourceFile(object):
 
         tagged = []
         for qi, s in enumerate(scanners):
+            if hasattr(s, 'finish'):
+                s.finish()   # merge any device-buffered batches
             for fields, value in s.aggr.points():
                 fields['__dn_metric'] = qi
                 tagged.append((fields, value))

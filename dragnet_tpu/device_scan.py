@@ -1,0 +1,731 @@
+"""Device-resident scan: the whole per-batch pipeline in one jit.
+
+The reference's hot loop ran predicate eval, date checks, bucketize and
+the aggregation hash update per record in JS callbacks
+(lib/krill-skinner-stream.js:29-52, lib/stream-scan.js:40-96; SURVEY
+§3.1).  VectorScan (engine.py) vectorizes those stages on the host and
+optionally offloads only the final segment-sum.  DeviceScan moves the
+*entire* post-parse pipeline onto the accelerator:
+
+    host:    C++ parse -> tagged columns -> eligibility checks ->
+             upload (i32/u8 columns + small lookup tables)
+    device:  predicate table-gathers + numeric compares -> ternary
+             and/or fold -> date-error & time-bounds masks -> p2/linear
+             bucketize -> mixed-radix key fusion -> segment-sum (or
+             one-hot MXU matmul) + first-occurrence segment-min
+             -> (dense accumulator, first-index, stage counters)
+
+and, critically, it does NOT synchronize per batch: results stay on the
+device as buffered jax arrays while the host parses ahead (jax async
+dispatch is the double-buffering), and are fetched + merged into the
+insertion-ordered Aggregator at flush points.  Emission order is
+preserved exactly: batches merge in submission order, and within a
+batch keys merge by first-occurrence row index (the segment-min), which
+is precisely the order the host engine inserts them.
+
+Exactness contract: everything uploaded is integer (i32 columns, i32
+weights) or a table gather, so device arithmetic is exact; any batch
+that cannot be represented exactly (non-integral weights or values,
+out-of-i32-range numbers, array-typed values in filter fields, ...)
+falls back to the host engine for that batch, after flushing the device
+buffer so insertion order survives.  Differential tests pin
+DeviceScan == VectorScan == StreamScan.
+"""
+
+import numpy as np
+
+from . import jsvalues as jsv
+from . import query as mod_query
+from .engine import (VectorScan, NativeColumns, MAX_DENSE_SEGMENTS,
+                     BATCH_SIZE, engine_mode)
+from .ops.kernels import FALSE, TRUE, ERROR
+from .ops import get_jax, backend_ready
+
+I32MIN = -(2 ** 31)
+I32MAX = 2 ** 31 - 1
+
+# numeric-row plans: outcome of <leaf op const> for an exact-int32 row
+NUM_FALSE, NUM_TRUE, NUM_EQ, NUM_NE, NUM_LE, NUM_GE = range(6)
+
+# flush the device buffer when the pending (dense + first) arrays
+# exceed this many bytes on device / in the fetch
+MAX_BUFFER_BYTES = 128 << 20
+MAX_BUFFER_BATCHES = 512
+
+
+def _pow2(x):
+    p = 8
+    while p < x:
+        p <<= 1
+    return p
+
+
+def numeric_leaf_plan(op, const):
+    """(mode, threshold) evaluating `value <op> const` for values that
+    are exact int32 numbers, with JS coercion semantics for const.
+    Returns None when no exact integer plan exists (then any batch with
+    numeric rows in that field falls back to the host engine)."""
+    import math
+    if isinstance(const, bool):
+        cf = 1.0 if const else 0.0
+    elif isinstance(const, (int, float)):
+        cf = jsv.as_float(const)
+    elif isinstance(const, str):
+        # number-vs-string compares coerce the string in JS (both for
+        # loose == and for relational operators)
+        cf = jsv.to_number(const)
+    else:
+        return None
+    if cf != cf:  # NaN: == false, != true, relational false
+        if op == 'ne':
+            return (NUM_TRUE, 0)
+        return (NUM_FALSE, 0)
+    if op in ('eq', 'ne'):
+        if math.isinf(cf) or cf != math.floor(cf) or \
+                not (I32MIN <= cf <= I32MAX):
+            return ((NUM_FALSE, 0) if op == 'eq' else (NUM_TRUE, 0))
+        t = int(cf)
+        return ((NUM_EQ, t) if op == 'eq' else (NUM_NE, t))
+    if math.isinf(cf):
+        big = cf > 0
+        if op in ('lt', 'le'):
+            return (NUM_TRUE, 0) if big else (NUM_FALSE, 0)
+        return (NUM_FALSE, 0) if big else (NUM_TRUE, 0)
+    f = math.floor(cf)
+    if op == 'lt':
+        t = int(f) - 1 if cf == f else int(f)   # v < c  <=>  v <= t
+        mode = NUM_LE
+    elif op == 'le':
+        t = int(f)                              # v <= floor(c)
+        mode = NUM_LE
+    elif op == 'gt':
+        t = int(f) + 1                          # v > c  <=>  v >= t
+        mode = NUM_GE
+    else:  # ge
+        t = int(f) if cf == f else int(f) + 1   # v >= ceil(c)
+        mode = NUM_GE
+    if mode == NUM_LE:
+        if t >= I32MAX:
+            return (NUM_TRUE, 0)
+        if t < I32MIN:
+            return (NUM_FALSE, 0)
+    else:
+        if t <= I32MIN:
+            return (NUM_TRUE, 0)
+        if t > I32MAX:
+            return (NUM_FALSE, 0)
+    return (mode, t)
+
+
+class _KeyPlan(object):
+    """Per-breakdown device plan + its growing window/capacity state."""
+
+    __slots__ = ('kind', 'name', 'field', 'step', 'lo', 'cap',
+                 'host_translate', 'column', 'window_set')
+
+    def __init__(self, kind, name, field=None, step=None, column=None):
+        self.kind = kind          # 'str' | 'p2' | 'lin'
+        self.name = name
+        self.field = field or name
+        self.step = step
+        self.column = column      # engine StringColumn for 'str'
+        self.lo = 0
+        self.cap = 8 if kind != 'p2' else 32
+        self.host_translate = False
+        self.window_set = False   # 'lin' window anchored to data yet?
+
+    def sig(self):
+        return (self.kind, self.lo, self.cap, self.step,
+                self.host_translate)
+
+
+class DeviceScan(VectorScan):
+    """VectorScan whose eligible batches execute fully on the device.
+
+    ESCALATE_RECORDS: batches are processed by the host engine until
+    this many records have been seen (device dispatch + compile are not
+    worth paying for CLI-sized inputs); 0 means device-first."""
+
+    ESCALATE_RECORDS = 0
+
+    def __init__(self, query, time_field, pipeline, ds_filter=None):
+        VectorScan.__init__(self, query, time_field, pipeline,
+                            ds_filter=ds_filter)
+        self._records_seen = 0
+        self._disabled = False
+        self._plans = None            # built lazily from the query
+        self._epoch_sig = None
+        self._programs = None
+        self._buffer = []             # [(meta, (dense, first, counters))]
+        self._buffer_bytes = 0
+        self._leaf_list = []          # [(key, Leaf)] in stable order
+        self._leaf_tables = {}        # leaf idx -> (host_len, device arr)
+        self._ctabs = {}              # leaf idx -> device i8[16]
+        self._trans_dev = {}          # plan name -> (host_len, device arr)
+        self._num_plans = []
+        self._counter_spec = None
+        self._synth_names = None
+        self._build_static()
+
+    # -- static (per-query) plan -------------------------------------------
+
+    def _build_static(self):
+        """Decide, once, whether this query can have a device program at
+        all, and precompute everything that doesn't depend on data."""
+        if get_jax() is None or not backend_ready():
+            self._disabled = True
+            return
+        synth_names = set(s['name'] for s in self.synthetic)
+        plans = []
+        for b in self.query.qc_breakdowns:
+            name = b['name']
+            if name in self.query.qc_bucketizers:
+                bz = self.query.qc_bucketizers[name]
+                if isinstance(bz, mod_query.P2Bucketizer):
+                    kind, step = 'p2', None
+                else:
+                    step = bz.step
+                    if not (isinstance(step, int) and
+                            not isinstance(step, bool) and
+                            1 <= step <= I32MAX):
+                        self._disabled = True
+                        return
+                    kind = 'lin'
+                if name in synth_names:
+                    field = next(s['field'] for s in self.synthetic
+                                 if s['name'] == name)
+                    plans.append(_KeyPlan(kind, name, field='\0synth:' +
+                                          name, step=step))
+                else:
+                    plans.append(_KeyPlan(kind, name, step=step))
+            else:
+                if name in synth_names:
+                    # synthetic (date) field used as a plain string key:
+                    # host path stringifies parsed seconds; rare — host
+                    self._disabled = True
+                    return
+                plans.append(_KeyPlan('str', name,
+                                      column=self.string_columns[name]))
+        self._plans = plans
+        self._synth_names = synth_names
+
+        for pred in (self.ds_pred, self.user_pred):
+            if pred is None:
+                continue
+            for key, leaf in pred.leaves.items():
+                if key not in [k for k, _ in self._leaf_list]:
+                    self._leaf_list.append((key, leaf))
+        for _, leaf in self._leaf_list:
+            self._num_plans.append(numeric_leaf_plan(leaf.op, leaf.const))
+
+        # counters, in the exact order the host engine bumps them
+        # (always=False counters are only bumped when nonzero, matching
+        # the host's conditional bumps)
+        spec = []
+        if self.ds_pred is not None:
+            s = self.ds_stage
+            spec += [(s, 'ninputs', True), (s, 'nfailedeval', False),
+                     (s, 'nfilteredout', False), (s, 'noutputs', True)]
+        if self.user_pred is not None:
+            s = self.user_stage
+            spec += [(s, 'ninputs', True), (s, 'nfailedeval', False),
+                     (s, 'nfilteredout', False), (s, 'noutputs', True)]
+        if self.synthetic:
+            s = self.synth_stage
+            spec += [(s, 'ninputs', True), (s, 'undef', False),
+                     (s, 'baddate', False), (s, 'noutputs', True)]
+        if self.time_bounds is not None:
+            s = self.time_stage
+            spec += [(s, 'ninputs', True), (s, 'nfilteredout', False),
+                     (s, 'noutputs', True)]
+        spec.append((self.aggr.stage, 'ninputs', True))
+        spec.append((self.aggr.stage, 'nnonnumeric', False))
+        self._counter_spec = spec
+
+    # -- per-batch entry ---------------------------------------------------
+
+    def _process(self, provider, weights, alive=None):
+        n = provider.n
+        self._records_seen += n
+        if not self._disabled and \
+                self._records_seen > self.ESCALATE_RECORDS:
+            if self._try_device(provider, weights, alive):
+                return
+        self._flush()
+        VectorScan._process(self, provider, weights, alive=alive)
+
+    def finish(self):
+        self._flush()
+        return self.aggr
+
+    # -- eligibility + input assembly --------------------------------------
+
+    def _try_device(self, provider, weights, alive):
+        """Assemble device inputs for this batch; True when submitted.
+        Any exactness precondition failure returns False (host path)."""
+        if not isinstance(provider, NativeColumns):
+            return False
+        mn = provider.mn
+        n = provider.n
+
+        w = np.asarray(weights, dtype=np.float64)
+        if len(w) != n or not np.all(np.isfinite(w)) or \
+                not np.all(w == np.floor(w)):
+            return False
+        total_w = float(np.abs(w).sum())
+        if total_w >= 2 ** 31 or (len(w) and
+                                  (w.min() < I32MIN or w.max() > I32MAX)):
+            return False
+
+        inputs = {}
+        inputs['alive'] = np.ones(n, dtype=bool) if alive is None \
+            else np.asarray(alive, dtype=bool)
+        inputs['weights'] = w.astype(np.int32)
+
+        # filter fields: tags + string codes + exact-i32 numeric values
+        for f in self.filter_fields:
+            tags, nums, strcodes = provider._field(f)
+            if (tags == mn.TAG_ARRAY).any():
+                return False
+            m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
+            iv = np.zeros(n, dtype=np.int32)
+            if m.any():
+                nm = nums[m]
+                if not (np.all(np.isfinite(nm)) and
+                        np.all(nm == np.floor(nm)) and
+                        nm.min() >= I32MIN and nm.max() <= I32MAX):
+                    return False
+                iv[m] = nm.astype(np.int64).astype(np.int32)
+            inputs['tags_' + f] = tags.astype(np.uint8, copy=False)
+            inputs['str_' + f] = strcodes.astype(np.int32, copy=False)
+            inputs['num_' + f] = iv
+
+        # synthetic date fields: combined first-error + needed ts columns
+        synth_vals = {}
+        if self.synthetic:
+            errs = None
+            for fc in self.synthetic:
+                vals, err = provider.date_column(fc['field'])
+                synth_vals[fc['name']] = vals
+                errs = err if errs is None else \
+                    np.where(errs == 0, err, errs)
+            ok = errs == 0
+            need = set()
+            if self.time_bounds is not None:
+                need.add('dn_ts')
+            for p in self._plans:
+                if p.field.startswith('\0synth:'):
+                    need.add(p.field[len('\0synth:'):])
+            for name in need:
+                v = synth_vals[name]
+                vo = v[ok]
+                if len(vo) and not (np.all(np.isfinite(vo)) and
+                                    np.all(vo == np.floor(vo)) and
+                                    vo.min() >= I32MIN and
+                                    vo.max() <= I32MAX):
+                    return False
+                inputs['ts_' + name] = np.where(ok, v, 0).astype(
+                    np.int64).astype(np.int32)
+            inputs['terr'] = errs
+
+        # key columns: update windows/caps, assemble uploads
+        new_caps = []
+        pending = []  # deferred plan-state commits
+        for p in self._plans:
+            if p.kind == 'str':
+                tags, nums, strcodes = provider._field(p.name)
+                all_str = bool((tags == mn.TAG_STRING).all())
+                host = p.host_translate or not all_str
+                if host:
+                    codes = np.asarray(
+                        provider.string_codes(p.name, p.column),
+                        dtype=np.int64)
+                    inputs['key_' + p.name] = codes.astype(np.int32)
+                else:
+                    from .engine import _native_str_trans
+                    trans = _native_str_trans(
+                        p.column, provider.parser.dictionary(p.name))
+                    cur = self._trans_dev.get(p.name)
+                    if cur is None or cur[0] < len(trans):
+                        jax, jnp = get_jax()
+                        # never ship a zero-length table: XLA gather
+                        # rejects slicing an empty operand (codes never
+                        # reference the pad entry)
+                        up = trans.astype(np.int32) if len(trans) \
+                            else np.zeros(1, dtype=np.int32)
+                        dev = jax.device_put(up)
+                        self._trans_dev[p.name] = (len(trans), dev)
+                    inputs['trans_' + p.name] = \
+                        self._trans_dev[p.name][1]
+                    inputs['str_' + p.name] = strcodes.astype(
+                        np.int32, copy=False)
+                radix = len(p.column.dict.values)
+                cap = max(p.cap, _pow2(max(radix, 1)))
+                new_caps.append(cap)
+                pending.append((p, cap, p.lo, host, True))
+            else:
+                if p.field.startswith('\0synth:'):
+                    sname = p.field[len('\0synth:'):]
+                    # window from real (err-free) timestamps only: the
+                    # zero-filled error rows are dead and must not
+                    # anchor the window at ordinal 0
+                    sel = synth_vals[sname][ok]
+                else:
+                    vals, valid = provider.numeric_column(p.name)
+                    vv = vals[valid]
+                    if len(vv) and not (np.all(np.isfinite(vv)) and
+                                        np.all(vv == np.floor(vv)) and
+                                        vv.min() >= I32MIN and
+                                        vv.max() <= I32MAX):
+                        return False
+                    fill = int(vv[0]) if len(vv) else 0
+                    v = np.where(valid, vals, fill).astype(np.int64)
+                    inputs['kv_' + p.name] = v.astype(np.int32)
+                    inputs['kvalid_' + p.name] = valid
+                    sel = vv
+                if p.kind == 'p2':
+                    new_caps.append(p.cap)  # fixed [0, 32)
+                    pending.append((p, p.cap, 0, False, True))
+                    continue
+                if len(sel):
+                    omin = int(np.floor_divide(int(sel.min()), p.step))
+                    omax = int(np.floor_divide(int(sel.max()), p.step))
+                    if p.window_set:
+                        lo = min(p.lo, omin)
+                        hi = max(p.lo + p.cap - 1, omax)
+                    else:
+                        lo, hi = omin, omax
+                    cap = max(p.cap, _pow2(hi - lo + 1))
+                    wset = True
+                else:
+                    lo, cap, wset = p.lo, p.cap, p.window_set
+                new_caps.append(cap)
+                pending.append((p, cap, lo, False, wset))
+
+        ns = 1
+        for c in new_caps:
+            ns *= c
+        if ns > MAX_DENSE_SEGMENTS:
+            self._disabled = True
+            return False
+
+        # commit plan-state changes; epoch flip rebuilds the program
+        for p, cap, lo, host, wset in pending:
+            p.cap, p.lo, p.host_translate = cap, lo, host
+            p.window_set = wset
+        sig = tuple(p.sig() for p in self._plans)
+        if sig != self._epoch_sig:
+            self._flush()
+            self._epoch_sig = sig
+            self._programs = None
+
+        # leaf outcome tables (grown host-side, resident on device)
+        for i, (key, leaf) in enumerate(self._leaf_list):
+            d = provider.parser.dictionary(leaf.field)
+            table = leaf.table_for(d)
+            cur = self._leaf_tables.get(i)
+            if cur is None or cur[0] < len(table):
+                jax, jnp = get_jax()
+                up = np.ascontiguousarray(table) if len(table) \
+                    else np.zeros(1, dtype=np.int8)
+                dev = jax.device_put(up)
+                self._leaf_tables[i] = (len(table), dev)
+            inputs['tab_%d' % i] = self._leaf_tables[i][1]
+            if i not in self._ctabs:
+                jax, jnp = get_jax()
+                ctab = np.zeros(16, dtype=np.int8)
+                ctab[mn.TAG_MISSING] = ERROR
+                ctab[mn.TAG_NULL] = leaf.outcome(None)
+                ctab[mn.TAG_FALSE] = leaf.outcome(False)
+                ctab[mn.TAG_TRUE] = leaf.outcome(True)
+                ctab[mn.TAG_OBJECT] = leaf.outcome({})
+                self._ctabs[i] = jax.device_put(ctab)
+            inputs['ctab_%d' % i] = self._ctabs[i]
+
+        # pad every per-record array to a stable capacity (batches can
+        # overshoot BATCH_SIZE: the streamer only flushes between reads)
+        pn = BATCH_SIZE
+        while pn < n:
+            pn <<= 1
+        if n < pn:
+            pad = pn - n
+            for k, v in list(inputs.items()):
+                if isinstance(v, np.ndarray) and v.ndim == 1 and \
+                        len(v) == n:
+                    inputs[k] = np.concatenate(
+                        [v, np.zeros(pad, dtype=v.dtype)])
+            inputs['alive'][n:] = False
+
+        progs = self._programs.get(pn) if self._programs else None
+        if progs is None:
+            progs = self._build_programs(tuple(new_caps), pn)
+            if self._programs is None:
+                self._programs = {}
+            self._programs[pn] = progs
+        run_scatter, run_pallas = progs
+        from .ops import pallas_kernels as pk
+        use_pallas = run_pallas is not None and \
+            pk.should_use(ns, total_w)
+        run = run_pallas if use_pallas else run_scatter
+        outs = run(inputs)
+
+        meta = {
+            'caps': tuple(new_caps),
+            'cols': [(p.kind, p.lo,
+                      p.column.dict.values if p.kind == 'str' else None)
+                     for p in self._plans],
+            'ns': ns,
+        }
+        self._buffer.append((meta, outs))
+        self._buffer_bytes += ns * 8 + 64
+        if self._buffer_bytes > MAX_BUFFER_BYTES or \
+                len(self._buffer) > MAX_BUFFER_BATCHES:
+            self._flush()
+        return True
+
+    # -- the device program -------------------------------------------------
+
+    def _build_programs(self, caps, n):
+        jax, jnp = get_jax()
+        from . import native as mod_native
+        mn = mod_native
+        from .ops import pallas_kernels as pk
+
+        plans = self._plans
+        leaf_index = {key: i for i, (key, _) in
+                      enumerate(self._leaf_list)}
+        num_plans = self._num_plans
+        time_bounds = self.time_bounds
+        has_synth = bool(self.synthetic)
+        ds_ast = self.ds_pred.ast if self.ds_pred is not None else None
+        user_ast = self.user_pred.ast if self.user_pred is not None \
+            else None
+        ns = 1
+        for c in caps:
+            ns *= c
+        i32 = jnp.int32
+
+        def leaf_out(key, args):
+            i = leaf_index[key]
+            _, leaf = self._leaf_list[i]
+            f = leaf.field
+            tags = args['tags_' + f]
+            out = args['ctab_%d' % i][tags]
+            out = jnp.where(tags == mn.TAG_STRING,
+                            args['tab_%d' % i][args['str_' + f]], out)
+            mode, t = num_plans[i]
+            numm = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
+            v = args['num_' + f]
+            if mode == NUM_FALSE:
+                nout = jnp.full((n,), FALSE, dtype=jnp.int8)
+            elif mode == NUM_TRUE:
+                nout = jnp.full((n,), TRUE, dtype=jnp.int8)
+            else:
+                tt = i32(t)
+                if mode == NUM_EQ:
+                    hit = v == tt
+                elif mode == NUM_NE:
+                    hit = v != tt
+                elif mode == NUM_LE:
+                    hit = v <= tt
+                else:
+                    hit = v >= tt
+                nout = jnp.where(hit, jnp.int8(TRUE), jnp.int8(FALSE))
+            return jnp.where(numm, nout, out)
+
+        def eval_ast(ast, args):
+            if not ast:
+                return jnp.full((n,), TRUE, dtype=jnp.int8)
+            op = next(iter(ast))
+            if op in ('and', 'or'):
+                outs = [eval_ast(sub, args) for sub in ast[op]]
+                state = outs[0]
+                stop = TRUE if op == 'and' else FALSE
+                for o in outs[1:]:
+                    state = jnp.where(state == stop, o, state)
+                return state
+            field, const = ast[op]
+            key = (field, op, jsv.json_stringify(const))
+            return leaf_out(key, args)
+
+        def p2_int(v):
+            x = jnp.maximum(v, i32(0))
+            bl = jnp.zeros_like(v)
+            for s in (16, 8, 4, 2, 1):
+                big = x >= i32(1 << s)
+                bl = bl + jnp.where(big, i32(s), i32(0))
+                x = jnp.where(big, jnp.right_shift(x, i32(s)), x)
+            bl = bl + jnp.where(x >= i32(1), i32(1), i32(0))
+            return jnp.where(v < i32(1), i32(0), bl)
+
+        def body(args, use_pallas):
+            alive = args['alive']
+            weights = args['weights']
+            counters = []
+
+            def isum(x):
+                return jnp.sum(x, dtype=jnp.int32)
+
+            for ast in (ds_ast, user_ast):
+                if ast is None:
+                    continue
+                counters.append(isum(alive))
+                out = eval_ast(ast, args)
+                counters.append(isum(alive & (out == ERROR)))
+                counters.append(isum(alive & (out == FALSE)))
+                alive = alive & (out == TRUE)
+                counters.append(isum(alive))
+
+            if has_synth:
+                counters.append(isum(alive))
+                terr = args['terr']
+                counters.append(isum(alive & (terr == 1)))   # UNDEF
+                counters.append(isum(alive & (terr == 2)))   # BADDATE
+                alive = alive & (terr == 0)
+                counters.append(isum(alive))
+
+            if time_bounds is not None:
+                counters.append(isum(alive))
+                ts = args['ts_dn_ts']
+                lo, hi = time_bounds
+                ok = jnp.ones((n,), dtype=bool)
+                if lo is not None:
+                    ok = ok & (ts >= i32(int(lo)))
+                if hi is not None:
+                    ok = ok & (ts < i32(int(hi)))
+                counters.append(isum(alive & ~ok))
+                alive = alive & ok
+                counters.append(isum(alive))
+
+            counters.append(isum(alive))   # aggregator ninputs
+            nnon = jnp.int32(0)
+            codes = []
+            for p in plans:
+                if p.kind == 'str':
+                    if p.host_translate:
+                        codes.append(args['key_' + p.name])
+                    else:
+                        codes.append(
+                            args['trans_' + p.name][args['str_' +
+                                                         p.name]])
+                    continue
+                if p.field.startswith('\0synth:'):
+                    v = args['ts_' + p.field[len('\0synth:'):]]
+                else:
+                    valid = args['kvalid_' + p.name]
+                    nnon = nnon + isum(alive & ~valid)
+                    alive = alive & valid
+                    v = args['kv_' + p.name]
+                if p.kind == 'p2':
+                    codes.append(p2_int(v))
+                else:
+                    codes.append(jnp.floor_divide(v, i32(p.step)) -
+                                 i32(p.lo))
+            counters.append(nnon)
+            cvec = jnp.stack(counters)
+
+            if not codes:
+                total = jnp.sum(
+                    jnp.where(alive, weights, i32(0)), dtype=jnp.int32)
+                dense = total[None]
+                first = jnp.zeros((1,), dtype=jnp.int32)
+                return dense, first, cvec
+
+            fused = jnp.zeros((n,), dtype=jnp.int32)
+            for c, cap in zip(codes, caps):
+                fused = fused * i32(cap) + c
+            fused = jnp.where(alive, fused, i32(ns))
+            idx = jax.lax.iota(jnp.int32, n)
+            first = jax.ops.segment_min(idx, fused,
+                                        num_segments=ns + 1)[:ns]
+            if use_pallas:
+                dense = pk.onehot_dense(
+                    caps, n, jnp.stack(codes),
+                    weights.astype(jnp.float32), alive,
+                    interpret=pk.needs_interpret())
+            else:
+                w = jnp.where(alive, weights, i32(0))
+                dense = jax.ops.segment_sum(w, fused,
+                                            num_segments=ns + 1)[:ns]
+            return dense, first, cvec
+
+        run_scatter = jax.jit(lambda args: body(args, False))
+        run_pallas = None
+        if pk.pallas_ok(ns) and pk.available():
+            run_pallas = jax.jit(lambda args: body(args, True))
+        return run_scatter, run_pallas
+
+    # -- flush: fetch + ordered merge ---------------------------------------
+
+    def _flush(self):
+        if not self._buffer:
+            return
+        buf = self._buffer
+        self._buffer = []
+        self._buffer_bytes = 0
+        spec = self._counter_spec
+        for meta, outs in buf:
+            dense = np.asarray(outs[0])
+            first = np.asarray(outs[1])
+            cvec = np.asarray(outs[2])
+            for (stage, name, always), v in zip(spec, cvec):
+                v = int(v)
+                if always or v:
+                    stage.bump(name, v)
+            if not meta['cols']:
+                self.aggr.write_key((), self._weight(float(dense[0])))
+                continue
+            occurred = np.nonzero(first < I32MAX)[0]
+            if len(occurred) == 0:
+                continue
+            order = np.argsort(first[occurred], kind='stable')
+            segs = occurred[order]
+            rem = segs.copy()
+            caps = meta['caps']
+            col_codes = [None] * len(caps)
+            for ci in range(len(caps) - 1, -1, -1):
+                col_codes[ci] = rem % caps[ci]
+                rem = rem // caps[ci]
+            cols_vals = []
+            for (kind, lo, values), cc in zip(meta['cols'], col_codes):
+                if kind == 'str':
+                    cols_vals.append([values[c] for c in cc.tolist()])
+                else:
+                    cols_vals.append([int(c) + lo for c in cc.tolist()])
+            wvals = dense[segs]
+            write_key = self.aggr.write_key
+            for keys, w in zip(zip(*cols_vals), wvals.tolist()):
+                w = float(w)
+                write_key(keys, int(w) if w.is_integer() else w)
+
+
+class AutoDeviceScan(DeviceScan):
+    """auto-mode DeviceScan: small scans stay on the host (device
+    dispatch/compile latency dominates), large ones escalate to the
+    device path mid-stream (host-processed batches were merged
+    immediately, so insertion order is preserved)."""
+
+    ESCALATE_RECORDS = 1 << 19
+
+
+def scan_class():
+    """The scan implementation for the current engine mode: DeviceScan
+    when a device backend should run the batch pipeline, else the host
+    VectorScan.  (DN_ENGINE=jax forces the device path; auto uses it on
+    TPU backends for large inputs.)"""
+    mode = engine_mode()
+    if mode == 'vector':     # force the host vectorized engine
+        return VectorScan
+    if mode == 'jax':
+        if backend_ready():
+            return DeviceScan
+        return VectorScan
+    if mode == 'auto':
+        j = get_jax()
+        if j is not None and backend_ready():
+            try:
+                if j[0].default_backend() == 'tpu':
+                    return AutoDeviceScan
+            except Exception:
+                pass
+    return VectorScan

@@ -1,0 +1,260 @@
+"""DeviceScan (full-pipeline-on-device) vs the host engine.
+
+Differentials run the datasource scan with DN_ENGINE=jax (which routes
+to DeviceScan; jit executes on the XLA:CPU test backend) against the
+host engine and the per-record reference path, over inputs that force
+batch-level fallbacks (arrays in filter fields, non-integral values),
+window growth across batches (time ordinals), dictionary growth, and
+mid-stream escalation — asserting identical points (including emission
+order) and identical pipeline counters."""
+
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import native as mod_native      # noqa: E402
+from dragnet_tpu import query as mod_query        # noqa: E402
+from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
+from dragnet_tpu.ops import get_jax, backend_ready  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    mod_native.get_lib() is None or get_jax() is None or
+    not backend_ready(),
+    reason='native parser or jax unavailable')
+
+
+def _mklines(rng, n):
+    hosts = ['a', 'b', 'c', 'host-%d', None, True, 17]
+    methods = ['GET', 'PUT', 'DELETE', None]
+    lines = []
+    import json
+    for i in range(n):
+        rec = {}
+        h = rng.choice(hosts)
+        if h == 'host-%d':
+            h = 'host-%d' % rng.randrange(40)
+        if rng.random() < 0.95:
+            rec['host'] = h
+        if rng.random() < 0.9:
+            rec['req'] = {'method': rng.choice(methods)}
+        if rng.random() < 0.95:
+            rec['latency'] = rng.choice(
+                [0, 1, 3, 17, 200, 4096, 123456, -2, '26', 'x', None])
+        if rng.random() < 0.95:
+            rec['code'] = rng.choice([200, 204, 404, 500, '500'])
+        if rng.random() < 0.95:
+            day = 1 + (i * 3 // n)
+            rec['time'] = '2014-05-%02dT%02d:%02d:%02dZ' % (
+                day, rng.randrange(24), rng.randrange(60),
+                rng.randrange(60))
+        elif rng.random() < 0.5:
+            rec['time'] = 'invalid'
+        lines.append(json.dumps(rec))
+    return lines
+
+
+EDGE_LINES = [
+    # array value in a filter/key field -> batch fallback
+    '{"host":[1,"two"],"latency":3,"code":200,'
+    '"time":"2014-05-01T01:00:00Z"}',
+    # non-integral latency -> batch fallback for quantize queries
+    '{"host":"a","latency":2.5,"code":200,'
+    '"time":"2014-05-01T02:00:00Z"}',
+    # out-of-i32 number in a field
+    '{"host":"a","latency":3,"code":123456789012345,'
+    '"time":"2014-05-01T03:00:00Z"}',
+    '{"host":{"x":1},"latency":4,"code":204,'
+    '"time":"2014-05-01T04:00:00Z"}',
+    'not json',
+    '{"latency":9}',
+]
+
+
+QUERIES = [
+    {},
+    {'breakdowns': [{'name': 'host'}]},
+    {'breakdowns': [{'name': 'req.method'}, {'name': 'host'}]},
+    {'breakdowns': [{'name': 'latency', 'aggr': 'quantize'}]},
+    {'breakdowns': [{'name': 'host'},
+                    {'name': 'latency', 'aggr': 'lquantize',
+                     'step': 100}]},
+    {'breakdowns': [{'name': 'code'}],
+     'filter': {'eq': ['req.method', 'GET']}},
+    {'breakdowns': [{'name': 'host'}],
+     'filter': {'or': [{'eq': ['code', '200']},
+                       {'and': [{'gt': ['latency', 100]},
+                                {'ne': ['host', 'a']}]}]}},
+    {'breakdowns': [{'name': 'code'}],
+     'filter': {'le': ['latency', 17]}},
+    {'breakdowns': [{'name': 'ts', 'field': 'time', 'date': '',
+                     'aggr': 'lquantize', 'step': 3600},
+                    {'name': 'req.method'}]},
+    {'timeAfter': '2014-05-01T06:00:00Z',
+     'timeBefore': '2014-05-02T12:00:00Z',
+     'breakdowns': [{'name': 'host'}]},
+]
+
+
+def _scan(monkeypatch, datafile, qconf, engine, batch=None):
+    monkeypatch.setenv('DN_ENGINE', engine)
+    monkeypatch.setenv('DN_NATIVE', '1')
+    monkeypatch.setenv('DN_SCAN_THREADS', '0')
+    monkeypatch.setenv('DN_PARSE_THREADS', '1')
+    if batch is not None:
+        from dragnet_tpu import engine as mod_engine
+        from dragnet_tpu import device_scan as mod_ds
+        monkeypatch.setattr(mod_engine, 'BATCH_SIZE', batch)
+        monkeypatch.setattr(mod_ds, 'BATCH_SIZE', batch)
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile, 'timeField': 'time'},
+        'ds_filter': {'ne': ['host', 'zzz']},
+        'ds_format': 'json',
+    })
+    r = ds.scan(mod_query.query_load(dict(qconf)))
+    counters = {(s.name, k): v for s in r.pipeline.stages
+                for k, v in s.counters.items() if v}
+    return r.points, counters
+
+
+@pytest.mark.parametrize('qi', range(len(QUERIES)))
+def test_device_differential(tmp_path, monkeypatch, qi):
+    rng = random.Random(99 + qi)
+    lines = _mklines(rng, 700)
+    # interleave edge lines so some batches fall back mid-stream
+    for i, el in enumerate(EDGE_LINES):
+        lines.insert((i + 1) * 90, el)
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    qconf = QUERIES[qi]
+    host_points, host_counters = _scan(monkeypatch, datafile, qconf,
+                                       engine='auto')
+    dev_points, dev_counters = _scan(monkeypatch, datafile, qconf,
+                                     engine='jax', batch=128)
+    assert host_points == dev_points, qconf
+    assert host_counters == dev_counters, qconf
+
+
+@pytest.mark.parametrize('qi', range(len(QUERIES)))
+def test_device_differential_clean(tmp_path, monkeypatch, qi):
+    """Clean input: every batch must actually take the device path (no
+    vacuous pass via fallback), results byte-identical to host."""
+    from dragnet_tpu import device_scan as mod_ds
+    ran = []
+    orig = mod_ds.DeviceScan._try_device
+
+    def spy(self, provider, weights, alive):
+        rv = orig(self, provider, weights, alive)
+        ran.append(rv)
+        return rv
+    rng = random.Random(7 + qi)
+    lines = [ln for ln in _mklines(rng, 500)
+             if '"x"' not in ln and '"26"' not in ln]
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    qconf = QUERIES[qi]
+    host_points, host_counters = _scan(monkeypatch, datafile, qconf,
+                                       engine='auto')
+    monkeypatch.setattr(mod_ds.DeviceScan, '_try_device', spy)
+    dev_points, dev_counters = _scan(monkeypatch, datafile, qconf,
+                                     engine='jax', batch=128)
+    assert host_points == dev_points, qconf
+    assert host_counters == dev_counters, qconf
+    assert ran and all(ran), 'device path never ran'
+
+
+def test_device_batches_actually_ran(tmp_path, monkeypatch):
+    """The differential is vacuous if every batch fell back — assert the
+    device path processed batches."""
+    from dragnet_tpu import device_scan as mod_ds
+    ran = []
+    orig = mod_ds.DeviceScan._try_device
+
+    def spy(self, provider, weights, alive):
+        rv = orig(self, provider, weights, alive)
+        ran.append(rv)
+        return rv
+    monkeypatch.setattr(mod_ds.DeviceScan, '_try_device', spy)
+    rng = random.Random(5)
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(_mklines(rng, 400)) + '\n')
+    _scan(monkeypatch, datafile, QUERIES[4], engine='jax', batch=64)
+    assert any(ran)
+
+
+def test_escalation_preserves_order(tmp_path, monkeypatch):
+    """auto-style escalation: host batches first, device after, same
+    emission order as all-host."""
+    from dragnet_tpu import device_scan as mod_ds
+    rng = random.Random(11)
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(_mklines(rng, 600)) + '\n')
+    host_points, _ = _scan(monkeypatch, datafile, QUERIES[2],
+                           engine='auto')
+    monkeypatch.setattr(mod_ds.DeviceScan, 'ESCALATE_RECORDS', 256)
+    dev_points, _ = _scan(monkeypatch, datafile, QUERIES[2],
+                          engine='jax', batch=128)
+    assert host_points == dev_points
+
+
+def test_numeric_leaf_plan_matches_outcome():
+    """The device's integer compare plans must agree with Leaf.outcome
+    (the JS semantics reference) for every int32 value."""
+    from dragnet_tpu.device_scan import (
+        numeric_leaf_plan, NUM_FALSE, NUM_TRUE, NUM_EQ, NUM_NE,
+        NUM_LE, NUM_GE, I32MIN, I32MAX)
+    from dragnet_tpu.engine import Leaf
+    from dragnet_tpu.ops.kernels import TRUE, FALSE
+
+    consts = [0, 1, -1, 5, 2.5, -2.5, 100.0, '26', '26.9', 'x', '',
+              True, False, 2 ** 31, -(2 ** 31) - 1, 2 ** 53 + 1,
+              1e300, -1e300, '0x1A', ' 7 ', 'Infinity']
+    probes = [I32MIN, I32MIN + 1, -101, -3, -2, -1, 0, 1, 2, 3, 5, 6,
+              25, 26, 27, 99, 100, 101, I32MAX - 1, I32MAX]
+    for const in consts:
+        for op in ('eq', 'ne', 'lt', 'le', 'gt', 'ge'):
+            plan = numeric_leaf_plan(op, const)
+            assert plan is not None, (op, const)
+            mode, t = plan
+            leaf = Leaf('f', op, const)
+            for v in probes:
+                expect = leaf.outcome(float(v))
+                if mode == NUM_FALSE:
+                    got = FALSE
+                elif mode == NUM_TRUE:
+                    got = TRUE
+                elif mode == NUM_EQ:
+                    got = TRUE if v == t else FALSE
+                elif mode == NUM_NE:
+                    got = TRUE if v != t else FALSE
+                elif mode == NUM_LE:
+                    got = TRUE if v <= t else FALSE
+                else:
+                    got = TRUE if v >= t else FALSE
+                assert got == expect, (op, const, v, plan)
+
+
+def test_device_pallas_program(tmp_path, monkeypatch):
+    """The one-hot MXU variant of the device program (interpret mode on
+    the CPU test backend) produces identical results."""
+    monkeypatch.setenv('DN_PALLAS', 'force')
+    rng = random.Random(21)
+    lines = [ln for ln in _mklines(rng, 300)
+             if '"x"' not in ln and '"26"' not in ln]
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    qconf = QUERIES[4]
+    host_points, _ = _scan(monkeypatch, datafile, qconf, engine='auto')
+    dev_points, _ = _scan(monkeypatch, datafile, qconf, engine='jax',
+                          batch=128)
+    assert host_points == dev_points
