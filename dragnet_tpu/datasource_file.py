@@ -211,6 +211,9 @@ class DatasourceFile(object):
             def build_worker(wp):
                 wscan = scan_cls(query, self.ds_timefield, wp,
                                  ds_filter=self.ds_filter)
+                # workers drain per batch through the recorder; the
+                # deferred columnar merge would hold rows past drain
+                wscan._defer_enabled = False
                 rec = scan_mt.BatchRecorder(wscan.aggr.stage)
                 wscan.aggr = rec
 
@@ -469,6 +472,7 @@ class DatasourceFile(object):
                 wpred, wstage, wscans, _ = make_scan_set(wp)
                 recs = []
                 for s in wscans:
+                    s._defer_enabled = False   # drained per batch
                     rec = scan_mt.BatchRecorder(s.aggr.stage)
                     s.aggr = rec
                     recs.append(rec)

@@ -32,6 +32,8 @@ buffer so insertion order survives.  Differential tests pin
 DeviceScan == VectorScan == StreamScan.
 """
 
+import time
+
 import numpy as np
 
 from . import jsvalues as jsv
@@ -39,7 +41,7 @@ from . import query as mod_query
 from .engine import (VectorScan, NativeColumns, MAX_DENSE_SEGMENTS,
                      BATCH_SIZE, engine_mode)
 from .ops.kernels import FALSE, TRUE, ERROR
-from .ops import get_jax, backend_ready
+from .ops import get_jax, backend_ready, accelerator_likely
 
 I32MIN = -(2 ** 31)
 I32MAX = 2 ** 31 - 1
@@ -144,14 +146,34 @@ class DeviceScan(VectorScan):
 
     ESCALATE_RECORDS: batches are processed by the host engine until
     this many records have been seen (device dispatch + compile are not
-    worth paying for CLI-sized inputs); 0 means device-first."""
+    worth paying for CLI-sized inputs); 0 means device-first.
+
+    REQUIRE_ACCELERATOR: when True the device path additionally
+    requires a non-CPU backend (auto mode); forced mode (DN_ENGINE=jax)
+    runs on whatever backend jax has, including the CPU test mesh.
+
+    PROBATION_RECORDS: when nonzero, the first device batch (jit
+    compile) is flushed, then this many device-processed records are
+    timed and compared against the host rate observed before
+    escalation; if the device is slower (e.g. a chip behind a slow
+    transport, or a query shape XLA handles badly), the scan
+    de-escalates back to the host engine permanently.  The backend
+    probe AND this crossover check only ever run past
+    ESCALATE_RECORDS, so small scans never touch the device plugin."""
 
     ESCALATE_RECORDS = 0
+    REQUIRE_ACCELERATOR = False
+    PROBATION_RECORDS = 0
 
     def __init__(self, query, time_field, pipeline, ds_filter=None):
         VectorScan.__init__(self, query, time_field, pipeline,
                             ds_filter=ds_filter)
         self._records_seen = 0
+        self._backend_ok = None
+        self._host_records = 0
+        self._host_rate = None
+        self._t0 = None
+        self._probation = None    # None=not started, tuple=timing, False=done
         self._disabled = False
         self._plans = None            # built lazily from the query
         self._epoch_sig = None
@@ -170,11 +192,12 @@ class DeviceScan(VectorScan):
     # -- static (per-query) plan -------------------------------------------
 
     def _build_static(self):
-        """Decide, once, whether this query can have a device program at
-        all, and precompute everything that doesn't depend on data."""
-        if get_jax() is None or not backend_ready():
-            self._disabled = True
-            return
+        """Decide, once, whether this query can have a device program
+        at all, and precompute everything that doesn't depend on data.
+        Deliberately touches NO jax state: backend availability is
+        probed lazily on the first batch past ESCALATE_RECORDS (the
+        first jax.devices() can block for minutes over a tunneled
+        device plugin, a price small host-only scans must not pay)."""
         synth_names = set(s['name'] for s in self.synthetic)
         plans = []
         for b in self.query.qc_breakdowns:
@@ -245,17 +268,61 @@ class DeviceScan(VectorScan):
     # -- per-batch entry ---------------------------------------------------
 
     def _process(self, provider, weights, alive=None):
+        if self._t0 is None:
+            self._t0 = time.monotonic()
         n = provider.n
         self._records_seen += n
         if not self._disabled and \
                 self._records_seen > self.ESCALATE_RECORDS:
             if self._try_device(provider, weights, alive):
+                self._after_device_batch(n)
                 return
         self._flush()
+        self._host_records += n
         VectorScan._process(self, provider, weights, alive=alive)
+
+    def _probe_backend(self):
+        """One-time lazy backend probe (first batch past the escalation
+        threshold).  False permanently disables the device path."""
+        ok = backend_ready()
+        if ok and self.REQUIRE_ACCELERATOR:
+            from .ops import is_accelerator
+            ok = is_accelerator()
+        self._backend_ok = ok
+        if not ok:
+            self._disabled = True
+        return ok
+
+    def _after_device_batch(self, n):
+        """Crossover probation: time a window of device batches against
+        the host rate observed pre-escalation and de-escalate if the
+        device loses (see PROBATION_RECORDS)."""
+        if not self.PROBATION_RECORDS or self._probation is False:
+            return
+        now = time.monotonic()
+        if self._probation is None:
+            # first device batch: pin the host rate, sync out the jit
+            # compile, and start the probation clock after it
+            if self._host_records and now > self._t0:
+                self._host_rate = self._host_records / (now - self._t0)
+            self._flush()
+            self._probation = (time.monotonic(), 0)
+            return
+        start, seen = self._probation
+        seen += n
+        if seen < self.PROBATION_RECORDS:
+            self._probation = (start, seen)
+            return
+        self._flush()
+        elapsed = time.monotonic() - start
+        rate = seen / elapsed if elapsed > 0 else float('inf')
+        if self._host_rate is not None and rate < self._host_rate:
+            self._disabled = True
+        self._probation = False
 
     def finish(self):
         self._flush()
+        self._defer_final()
         return self.aggr
 
     # -- eligibility + input assembly --------------------------------------
@@ -264,6 +331,8 @@ class DeviceScan(VectorScan):
         """Assemble device inputs for this batch; True when submitted.
         Any exactness precondition failure returns False (host path)."""
         if not isinstance(provider, NativeColumns):
+            return False
+        if self._backend_ok is None and not self._probe_backend():
             return False
         mn = provider.mn
         n = provider.n
@@ -589,10 +658,25 @@ class DeviceScan(VectorScan):
                 ts = args['ts_dn_ts']
                 lo, hi = time_bounds
                 ok = jnp.ones((n,), dtype=bool)
+                # Bounds are Python ints baked at trace time and may lie
+                # outside int32 (a far-future timeBefore as "unbounded"
+                # is a plausible idiom; jnp.int32(2208988800) raises on
+                # numpy>=2).  Uploaded ts values are exact-i32 (the
+                # eligibility check falls back otherwise), so an
+                # out-of-range bound resolves statically: vacuous or
+                # nothing-passes.
                 if lo is not None:
-                    ok = ok & (ts >= i32(int(lo)))
+                    lo = int(lo)
+                    if lo > I32MAX:
+                        ok = ok & False
+                    elif lo > I32MIN:
+                        ok = ok & (ts >= i32(lo))
                 if hi is not None:
-                    ok = ok & (ts < i32(int(hi)))
+                    hi = int(hi)
+                    if hi <= I32MIN:
+                        ok = ok & False
+                    elif hi <= I32MAX:
+                        ok = ok & (ts < i32(hi))
                 counters.append(isum(alive & ~ok))
                 alive = alive & ok
                 counters.append(isum(alive))
@@ -686,46 +770,47 @@ class DeviceScan(VectorScan):
             for ci in range(len(caps) - 1, -1, -1):
                 col_codes[ci] = rem % caps[ci]
                 rem = rem // caps[ci]
-            cols_vals = []
+            # global codes for the shared emit path: device string codes
+            # are already engine-dictionary codes; bucket codes offset
+            # by the window origin give raw ordinals
+            gcols = []
             for (kind, lo, values), cc in zip(meta['cols'], col_codes):
                 if kind == 'str':
-                    cols_vals.append([values[c] for c in cc.tolist()])
+                    gcols.append(np.asarray(cc, dtype=np.int64))
                 else:
-                    cols_vals.append([int(c) + lo for c in cc.tolist()])
-            wvals = dense[segs]
-            write_key = self.aggr.write_key
-            for keys, w in zip(zip(*cols_vals), wvals.tolist()):
-                w = float(w)
-                write_key(keys, int(w) if w.is_integer() else w)
+                    gcols.append(np.asarray(cc, dtype=np.int64) + lo)
+            self._emit_unique(gcols, dense[segs].astype(np.float64))
 
 
 class AutoDeviceScan(DeviceScan):
     """auto-mode DeviceScan: small scans stay on the host (device
-    dispatch/compile latency dominates), large ones escalate to the
-    device path mid-stream (host-processed batches were merged
-    immediately, so insertion order is preserved)."""
+    dispatch/compile latency dominates — the backend is not even
+    probed below the threshold), large ones escalate to the device
+    path mid-stream (host-processed batches were merged immediately,
+    so insertion order is preserved), and a probation window
+    de-escalates if the device turns out slower than the host
+    (crossover detection)."""
 
     ESCALATE_RECORDS = 1 << 19
+    REQUIRE_ACCELERATOR = True
+    PROBATION_RECORDS = 1 << 20
 
 
 def scan_class():
     """The scan implementation for the current engine mode: DeviceScan
     when a device backend should run the batch pipeline, else the host
     VectorScan.  (DN_ENGINE=jax forces the device path; auto uses it on
-    TPU backends for large inputs.)"""
+    accelerator backends for large inputs.)
+
+    Initializes NO backend: auto mode routes on accelerator_likely()
+    (pure env inspection), and the device classes probe the real
+    backend lazily on the first batch past their escalation threshold —
+    so a CLI scan over a small file never blocks on device-plugin
+    startup (previously jax.devices() here could hang >80s over a
+    tunneled plugin before any work started)."""
     mode = engine_mode()
-    if mode == 'vector':     # force the host vectorized engine
-        return VectorScan
     if mode == 'jax':
-        if backend_ready():
-            return DeviceScan
-        return VectorScan
-    if mode == 'auto':
-        j = get_jax()
-        if j is not None and backend_ready():
-            try:
-                if j[0].default_backend() == 'tpu':
-                    return AutoDeviceScan
-            except Exception:
-                pass
+        return DeviceScan
+    if mode == 'auto' and accelerator_likely():
+        return AutoDeviceScan
     return VectorScan

@@ -40,6 +40,15 @@ from .ops.kernels import FALSE, TRUE, ERROR
 BATCH_SIZE = 65536
 MAX_DENSE_SEGMENTS = 1 << 24
 
+# Deferred columnar merge: when a batch yields at least this many unique
+# key tuples, batch results are buffered as (global-code columns, weight
+# sums) and collapsed to final uniques once, at finish — Python-object
+# work then scales with output tuples, not records.  The buffer is
+# compacted (unique+sum) whenever it exceeds DEFER_COMPACT_ROWS, so
+# memory stays bounded by unique tuples.
+DEFER_UNIQUE = 4096
+DEFER_COMPACT_ROWS = 1 << 21
+
 
 def engine_mode():
     return os.environ.get('DN_ENGINE', 'auto')
@@ -59,6 +68,43 @@ def _native_str_trans(column, parser_dict):
         cache = np.concatenate([cache, new])
         column._native_trans = cache
     return cache
+
+
+def _unique_rows(gcols):
+    """Unique rows of a tuple of equal-length int64 code columns.
+    Returns (first_idx, inv, order): first-occurrence index per unique
+    row, per-row inverse mapping, and the permutation putting uniques
+    in first-occurrence order.  Fuses to one mixed-radix int64 when the
+    span product fits (1-D unique is much faster); row-wise unique
+    otherwise."""
+    n = len(gcols[0])
+    if n == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z, z
+    spans = []
+    prod = 1
+    ok = True
+    for arr in gcols:
+        lo = int(arr.min())
+        span = int(arr.max()) - lo + 1
+        if prod > (2 ** 62) // max(span, 1):
+            ok = False
+            break
+        prod *= span
+        spans.append((lo, span))
+    if ok:
+        fused = np.zeros(n, dtype=np.int64)
+        for arr, (lo, span) in zip(gcols, spans):
+            fused = fused * span + (arr - lo)
+        _, first_idx, inv = np.unique(fused, return_index=True,
+                                      return_inverse=True)
+    else:
+        mat = np.stack(gcols, axis=1)
+        _, first_idx, inv = np.unique(mat, axis=0, return_index=True,
+                                      return_inverse=True)
+        inv = inv.reshape(-1)
+    order = np.argsort(first_idx, kind='stable')
+    return first_idx, inv, order
 
 
 def _compact_codes(ords):
@@ -443,6 +489,20 @@ class VectorScan(object):
             if b['name'] not in query.qc_bucketizers:
                 self.string_columns[b['name']] = mod_batch.StringColumn()
 
+        # per-breakdown decode plan for _emit_unique: bucketized columns
+        # carry raw ordinals ('ord'), string columns carry codes into
+        # the (append-only) engine dictionary
+        self._breakdown_cols = []
+        for b in query.qc_breakdowns:
+            if b['name'] in query.qc_bucketizers:
+                self._breakdown_cols.append(('ord', None))
+            else:
+                self._breakdown_cols.append(
+                    ('str', self.string_columns[b['name']]))
+        self._defer = None        # ([col chunk lists], [weight chunks])
+        self._defer_rows = 0
+        self._defer_enabled = True   # scan_mt workers turn this off
+
     # -- projection (what the native parser must extract) -----------------
 
     def projection(self):
@@ -616,15 +676,18 @@ class VectorScan(object):
             fused_order = uniq[order]
             rows = idx[first_idx[order]]
 
-        # decode each unique's key from its first-occurrence row (no
-        # per-key divmod), then stream tuples into the aggregator
-        cols_vals = []
-        for codes, dec in zip(key_codes, decoders):
-            cols_vals.append([dec[c] for c in codes[rows].tolist()])
-        write_key = self.aggr.write_key
-        for keys, w in zip(zip(*cols_vals),
-                           dense[fused_order].tolist()):
-            write_key(keys, int(w) if w.is_integer() else w)
+        # read each unique's key from its first-occurrence row (no
+        # per-key divmod) as GLOBAL codes: raw bucket ordinals, engine
+        # dictionary codes for strings
+        gcols = []
+        for (kind, _), codes, dec in zip(self._breakdown_cols,
+                                         key_codes, decoders):
+            cc = codes[rows]
+            if kind == 'ord':
+                gcols.append(np.asarray(dec, dtype=np.int64)[cc])
+            else:
+                gcols.append(np.asarray(cc, dtype=np.int64))
+        self._emit_unique(gcols, dense[fused_order])
 
     def _weight(self, w):
         w = float(w)  # numpy scalar -> python (affects str() rendering)
@@ -681,13 +744,99 @@ class VectorScan(object):
         return np.bincount(fused, weights=w, minlength=num_segments)
 
     def _sparse_merge(self, key_codes, decoders, weights, alive):
-        """Cardinality overflow: merge per-record (bounded-memory hash
-        aggregation instead of a dense accumulator)."""
+        """Cardinality overflow: the composite key space exceeds
+        MAX_DENSE_SEGMENTS, so no dense accumulator.  Vectorized hash
+        aggregation instead: group the batch by unique key tuples
+        (np.unique), sum weights per group (bincount), and merge the
+        groups into the running Aggregator in first-occurrence order —
+        identical emission order to the dense path and the per-record
+        host reference, with Python work O(unique tuples), not
+        O(records).  The spill is surfaced in --counters
+        ('nspillrecords' on the aggregator stage): memory is now
+        bounded by unique output tuples, the reference's scaling law
+        (README.md:668-681), rather than the dense budget."""
         idx = np.nonzero(alive)[0]
-        for i in idx.tolist():
-            key = tuple(dec[int(codes[i])]
-                        for codes, dec in zip(key_codes, decoders))
-            self.aggr.write_key(key, self._weight(float(weights[i])))
+        if len(idx) == 0:
+            return
+        self.aggr.stage.bump('nspillrecords', int(len(idx)))
+
+        gcols = []
+        for (kind, _), codes, dec in zip(self._breakdown_cols,
+                                         key_codes, decoders):
+            cc = np.asarray(codes, dtype=np.int64)[idx]
+            if kind == 'ord':
+                gcols.append(np.asarray(dec, dtype=np.int64)[cc])
+            else:
+                gcols.append(cc)
+        first_idx, inv, order = _unique_rows(gcols)
+        wsum = np.bincount(inv, weights=weights[idx],
+                           minlength=len(first_idx))
+        rows = first_idx[order]
+        self._emit_unique([arr[rows] for arr in gcols], wsum[order])
+
+    # -- unique-tuple emission / deferred columnar merge -------------------
+
+    def _emit_unique(self, gcols, wvals):
+        """One batch's aggregation result: per-column GLOBAL codes (raw
+        bucket ordinals / engine string-dictionary codes, both stable
+        across batches) in first-occurrence order, with dense weight
+        sums.  Written straight into the Aggregator, or — once a batch
+        crosses DEFER_UNIQUE tuples — appended to the deferred columnar
+        buffer collapsed at finish, so high-cardinality scans do
+        per-tuple Python work once per OUTPUT tuple, not per batch."""
+        if self._defer is None and self._defer_enabled and gcols and \
+                len(wvals) >= DEFER_UNIQUE:
+            self._defer = ([[] for _ in gcols], [])
+        if self._defer is not None:
+            cols, ws = self._defer
+            for lst, arr in zip(cols, gcols):
+                lst.append(np.asarray(arr, dtype=np.int64))
+            ws.append(np.asarray(wvals, dtype=np.float64))
+            self._defer_rows += len(wvals)
+            if self._defer_rows > DEFER_COMPACT_ROWS:
+                self._defer_compact()
+            return
+        cols_vals = []
+        for arr, (kind, col) in zip(gcols, self._breakdown_cols):
+            if kind == 'str':
+                values = col.dict.values
+                cols_vals.append([values[c] for c in arr.tolist()])
+            else:
+                cols_vals.append(arr.tolist())
+        write_key = self.aggr.write_key
+        if not cols_vals:
+            for w in np.asarray(wvals, dtype=np.float64).tolist():
+                write_key((), self._weight(w))
+            return
+        for keys, w in zip(zip(*cols_vals),
+                           np.asarray(wvals,
+                                      dtype=np.float64).tolist()):
+            write_key(keys, self._weight(w))
+
+    def _defer_compact(self):
+        """Collapse the deferred buffer to its unique tuples (weights
+        summed, first-occurrence order preserved) — bounds buffer
+        memory by unique tuples, the reference's scaling law
+        (README.md:668-681)."""
+        cols, ws = self._defer
+        gcols = [c[0] if len(c) == 1 else np.concatenate(c)
+                 for c in cols]
+        w = ws[0] if len(ws) == 1 else np.concatenate(ws)
+        first_idx, inv, order = _unique_rows(gcols)
+        wsum = np.bincount(inv, weights=w, minlength=len(first_idx))
+        rows = first_idx[order]
+        self._defer = ([[arr[rows]] for arr in gcols], [wsum[order]])
+        self._defer_rows = len(rows)
+
+    def _defer_final(self):
+        if self._defer is None:
+            return
+        self._defer_compact()
+        cols, ws = self._defer
+        self._defer = None
+        self._defer_enabled = False   # direct write from here on
+        self._emit_unique([c[0] for c in cols], ws[0])
 
     def finish(self):
+        self._defer_final()
         return self.aggr

@@ -576,8 +576,10 @@ class DncIndexQuerier(IndexQuerierBase):
         floor/ceil integer comparisons."""
         import math
         if math.isnan(const):
-            # REAL NaN sorts before every INTEGER in SQLite
-            return self._all_if(op in ('gt', 'ge', 'ne'), n)
+            # SQLite stores NaN as NULL, and NULL comparisons match no
+            # rows whatever the operator.  (Defensive only: json_parse
+            # and krill reject non-finite constants upstream.)
+            return np.zeros(n, dtype=bool)
         if math.isinf(const):
             if const > 0:
                 return self._all_if(op in ('lt', 'le', 'ne'), n)

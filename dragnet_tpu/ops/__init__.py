@@ -48,7 +48,12 @@ def backend_ready():
     """True when jax's platform actually initializes (e.g. False when a
     device plugin's site hook was skipped under CLI fast start but
     JAX_PLATFORMS still names it) — the gate for device execution paths
-    to degrade to the host engine instead of crashing."""
+    to degrade to the host engine instead of crashing.
+
+    NOTE: the first call fully initializes the backend, which can take
+    minutes over a tunneled device plugin.  Callers on latency-sensitive
+    paths must consult platform_hint() first and defer this probe until
+    device execution is actually wanted (see device_scan.scan_class)."""
     global _backend_ready
     if _backend_ready is None:
         j = get_jax()
@@ -61,3 +66,72 @@ def backend_ready():
             except Exception:
                 _backend_ready = False
     return _backend_ready
+
+
+def platform_hint():
+    """Cheap, non-backend-initializing guess at the jax platform: the
+    first entry of JAX_PLATFORMS ('' when unset, meaning jax would
+    auto-select).  Used to route small scans to the host engine without
+    paying backend initialization (over a tunneled device plugin the
+    first jax.devices() can block for minutes)."""
+    import os
+    return (os.environ.get('JAX_PLATFORMS') or '').split(',')[0] \
+        .strip().lower()
+
+
+def accelerator_likely():
+    """Whether an accelerator backend is plausibly present, WITHOUT
+    initializing it: a non-cpu JAX_PLATFORMS entry (TPU plugins register
+    under their own names — 'tpu', 'axon', ...), or, when unset, a
+    libtpu install that jax's auto-selection would pick up.  The device
+    path re-checks with is_accelerator() (a real probe) before running."""
+    hint = platform_hint()
+    if hint:
+        return hint != 'cpu'
+    import importlib.util
+    try:
+        return importlib.util.find_spec('libtpu') is not None
+    except (ImportError, ValueError):
+        return False
+
+
+def device_platform():
+    """Platform name of jax's default backend ('cpu', 'tpu', 'axon',
+    ...), or None when no backend initializes.  Initializes the
+    backend — see the backend_ready() latency note."""
+    if not backend_ready():
+        return None
+    jax, _ = get_jax()
+    try:
+        return jax.default_backend()
+    except Exception:
+        return None
+
+
+def is_accelerator():
+    """True when the default backend is a live accelerator — anything
+    other than XLA:CPU.  A platform-name equality test would be wrong
+    twice over: TPU plugins register under their own platform names
+    (this rig's TPU shows up as 'axon', not 'tpu'), and new plugin
+    names keep appearing; not-CPU is the capability that matters for
+    routing batches to the device."""
+    p = device_platform()
+    return p is not None and p != 'cpu'
+
+
+def is_tpu_backend():
+    """True when the default backend's devices are TPU chips — i.e.
+    Mosaic can compile Pallas kernels for them: the 'tpu' platform
+    proper, or a PJRT plugin whose device_kind identifies a TPU (the
+    'axon' relay platform registers TPU v5e devices)."""
+    p = device_platform()
+    if p is None or p == 'cpu':
+        return False
+    if p in ('tpu', 'axon'):
+        return True
+    jax, _ = get_jax()
+    try:
+        kind = (getattr(jax.devices()[0], 'device_kind', '') or '')
+        return 'tpu' in kind.lower()
+    except Exception:
+        return False

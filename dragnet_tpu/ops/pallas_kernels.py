@@ -188,20 +188,13 @@ def should_use(num_segments, total_weight):
         return False
     if os.environ.get('DN_PALLAS') == 'force':
         return True
-    j = get_jax()
-    jax, _ = j
-    try:
-        return jax.default_backend() == 'tpu'
-    except Exception:
-        return False
+    from . import is_tpu_backend
+    return is_tpu_backend()
 
 
 def needs_interpret():
-    """Mosaic only compiles for TPU; other backends (the CPU test mesh)
-    run the kernel in interpret mode."""
-    j = get_jax()
-    jax, _ = j
-    try:
-        return jax.default_backend() not in ('tpu',)
-    except Exception:
-        return True
+    """Mosaic only compiles for TPU backends (including TPU plugin
+    platforms like 'axon'); others (the CPU test mesh) run the kernel
+    in interpret mode."""
+    from . import is_tpu_backend
+    return not is_tpu_backend()

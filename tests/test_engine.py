@@ -77,6 +77,7 @@ def run_vector(query, records, weights, time_field, batch=37):
     for i in range(0, len(records), batch):
         s.write_batch([dict(r) for r in records[i:i + batch]],
                       weights[i:i + batch])
+    s.finish()
     return s.aggr.points(), pipeline
 
 
@@ -148,3 +149,60 @@ def test_sparse_merge_cardinality_overflow(monkeypatch):
         mod_query.query_load(qspec), records, weights, None)
     assert host_points == vec_points
     assert len(vec_points) > 64  # really exceeded the dense budget
+
+
+def test_spill_counter_visible(monkeypatch):
+    """The cardinality spill surfaces in --counters (nspillrecords on
+    the aggregator stage) so the budget overflow is observable."""
+    from dragnet_tpu import engine as mod_engine
+    monkeypatch.setattr(mod_engine, 'MAX_DENSE_SEGMENTS', 4)
+    records = [{'host': 'h%d' % i} for i in range(50)]
+    q = mod_query.query_load({'breakdowns': [{'name': 'host'}]})
+    _, pipe = run_vector(q, records, [1] * len(records), None)
+    counters = {(s.name, k): v for s in pipe.stages
+                for k, v in s.counters.items()}
+    assert counters[('Aggregator', 'nspillrecords')] == 50
+
+
+@pytest.mark.parametrize('qi', range(len(QUERIES)))
+def test_deferred_merge_differential(qi, monkeypatch):
+    """The deferred columnar merge (activated for high-unique batches;
+    forced low here, with mid-stream compaction) must be invisible:
+    identical points and emission order to the per-batch write path."""
+    from dragnet_tpu import engine as mod_engine
+    monkeypatch.setattr(mod_engine, 'DEFER_UNIQUE', 2)
+    monkeypatch.setattr(mod_engine, 'DEFER_COMPACT_ROWS', 7)
+
+    rng = random.Random(4321 + qi)
+    records = [random_record(rng) for _ in range(400)]
+    weights = [rng.choice([1, 1, 2, 5, 0]) for _ in records]
+
+    qspec = dict(QUERIES[qi])
+    time_field = qspec.pop('timeField_', None)
+    host_points, _ = run_host(
+        mod_query.query_load(qspec, allow_reserved=True),
+        records, weights, time_field)
+    vec_points, _ = run_vector(
+        mod_query.query_load(qspec, allow_reserved=True),
+        records, weights, time_field)
+    assert host_points == vec_points
+
+
+def test_deferred_merge_bounded(monkeypatch):
+    """Compaction keeps the deferred buffer bounded by unique tuples."""
+    from dragnet_tpu import engine as mod_engine
+    monkeypatch.setattr(mod_engine, 'DEFER_UNIQUE', 2)
+    monkeypatch.setattr(mod_engine, 'DEFER_COMPACT_ROWS', 10)
+    pipeline = Pipeline()
+    q = mod_query.query_load({'breakdowns': [{'name': 'host'}]})
+    s = VectorScan(q, None, pipeline)
+    for i in range(100):
+        s.write_batch([{'host': 'h%d' % (j % 5)} for j in range(8)],
+                      [1] * 8)
+        assert s._defer is None or s._defer_rows <= 10 + 8
+    s.finish()
+    pts = s.aggr.points()
+    # hosts cycle j%5 over 8 records: h0-h2 twice per batch, h3-h4 once
+    assert [(p[0]['host'], p[1]) for p in pts] == \
+        [('h0', 200), ('h1', 200), ('h2', 200), ('h3', 100),
+         ('h4', 100)]
