@@ -15,13 +15,16 @@ optionally offloads only the final segment-sum.  DeviceScan moves the
              one-hot MXU matmul) + first-occurrence segment-min
              -> (dense accumulator, first-index, stage counters)
 
-and, critically, it does NOT synchronize per batch: results stay on the
-device as buffered jax arrays while the host parses ahead (jax async
-dispatch is the double-buffering), and are fetched + merged into the
-insertion-ordered Aggregator at flush points.  Emission order is
-preserved exactly: batches merge in submission order, and within a
-batch keys merge by first-occurrence row index (the segment-min), which
-is precisely the order the host engine inserts them.
+and, critically, it does NOT synchronize per batch: each batch's
+(dense, first, counters) triple is folded into a device-RESIDENT i64
+accumulator inside the same jit (dense/counters add; first-occurrence
+keys take a global min over batch_base + row), so a scan performs ONE
+device->host fetch per program epoch rather than one per batch — the
+difference between ~0.1s and ~10s of pure round-trip latency on a
+tunneled device plugin at 2M records.  Emission order is preserved
+exactly: the accumulated first-occurrence key (batch_index << row
+ordering) sorts keys by submission batch then first row within the
+batch, which is precisely the order the host engine inserts them.
 
 Exactness contract: everything uploaded is integer (i32 columns, i32
 weights) or a table gather, so device arithmetic is exact; any batch
@@ -49,10 +52,14 @@ I32MAX = 2 ** 31 - 1
 # numeric-row plans: outcome of <leaf op const> for an exact-int32 row
 NUM_FALSE, NUM_TRUE, NUM_EQ, NUM_NE, NUM_LE, NUM_GE = range(6)
 
-# flush the device buffer when the pending (dense + first) arrays
-# exceed this many bytes on device / in the fetch
-MAX_BUFFER_BYTES = 128 << 20
-MAX_BUFFER_BATCHES = 512
+I64MAX = 2 ** 63 - 1
+
+# jitted scan programs are shared across DeviceScan instances (a CLI
+# `dn scan` and the bench's repeat runs would otherwise re-trace and
+# re-compile identical programs per scan); keyed by the full static
+# structure of the program (see _program_key)
+_PROGRAM_CACHE = {}
+_ACC_INIT_CACHE = {}
 
 
 def _pow2(x):
@@ -60,6 +67,16 @@ def _pow2(x):
     while p < x:
         p <<= 1
     return p
+
+
+def _pad_pow2(arr):
+    """Zero-pad a 1-D table to a power-of-two length so device-side
+    shapes (= jit cache keys) change O(log) times as it grows."""
+    pw = _pow2(len(arr))
+    if len(arr) < pw:
+        arr = np.concatenate(
+            [arr, np.zeros(pw - len(arr), dtype=arr.dtype)])
+    return arr
 
 
 def numeric_leaf_plan(op, const):
@@ -164,6 +181,7 @@ class DeviceScan(VectorScan):
     ESCALATE_RECORDS = 0
     REQUIRE_ACCELERATOR = False
     PROBATION_RECORDS = 0
+    PROBATION_SECONDS = 0.25
 
     def __init__(self, query, time_field, pipeline, ds_filter=None):
         VectorScan.__init__(self, query, time_field, pipeline,
@@ -178,8 +196,9 @@ class DeviceScan(VectorScan):
         self._plans = None            # built lazily from the query
         self._epoch_sig = None
         self._programs = None
-        self._buffer = []             # [(meta, (dense, first, counters))]
-        self._buffer_bytes = 0
+        self._acc = None              # device-resident (dense, first, cvec)
+        self._acc_meta = None         # epoch ('caps', 'cols', 'ns')
+        self._acc_batch = 0           # batches folded into the acc
         self._leaf_list = []          # [(key, Leaf)] in stable order
         self._leaf_tables = {}        # leaf idx -> (host_len, device arr)
         self._ctabs = {}              # leaf idx -> device i8[16]
@@ -293,10 +312,21 @@ class DeviceScan(VectorScan):
             self._disabled = True
         return ok
 
+    def _sync_device(self):
+        """Block until every batch folded so far has executed (without
+        fetching or emitting anything) — the timing barrier for
+        probation measurements."""
+        if self._acc is not None:
+            jax, _ = get_jax()
+            jax.block_until_ready(self._acc)
+
     def _after_device_batch(self, n):
         """Crossover probation: time a window of device batches against
         the host rate observed pre-escalation and de-escalate if the
-        device loses (see PROBATION_RECORDS)."""
+        device loses.  The window is bounded by PROBATION_RECORDS *or*
+        PROBATION_SECONDS, whichever trips first — a record-count-only
+        window on a slow device path spends most of a scan measuring it
+        (the round-3 scale cliff)."""
         if not self.PROBATION_RECORDS or self._probation is False:
             return
         now = time.monotonic()
@@ -305,15 +335,16 @@ class DeviceScan(VectorScan):
             # compile, and start the probation clock after it
             if self._host_records and now > self._t0:
                 self._host_rate = self._host_records / (now - self._t0)
-            self._flush()
+            self._sync_device()
             self._probation = (time.monotonic(), 0)
             return
         start, seen = self._probation
         seen += n
-        if seen < self.PROBATION_RECORDS:
+        if seen < self.PROBATION_RECORDS and \
+                now - start < self.PROBATION_SECONDS:
             self._probation = (start, seen)
             return
-        self._flush()
+        self._sync_device()
         elapsed = time.monotonic() - start
         rate = seen / elapsed if elapsed > 0 else float('inf')
         if self._host_rate is not None and rate < self._host_rate:
@@ -419,10 +450,10 @@ class DeviceScan(VectorScan):
                         jax, jnp = get_jax()
                         # never ship a zero-length table: XLA gather
                         # rejects slicing an empty operand (codes never
-                        # reference the pad entry)
+                        # reference the pad entries)
                         up = trans.astype(np.int32) if len(trans) \
                             else np.zeros(1, dtype=np.int32)
-                        dev = jax.device_put(up)
+                        dev = jax.device_put(_pad_pow2(up))
                         self._trans_dev[p.name] = (len(trans), dev)
                     inputs['trans_' + p.name] = \
                         self._trans_dev[p.name][1]
@@ -497,7 +528,7 @@ class DeviceScan(VectorScan):
                 jax, jnp = get_jax()
                 up = np.ascontiguousarray(table) if len(table) \
                     else np.zeros(1, dtype=np.int8)
-                dev = jax.device_put(up)
+                dev = jax.device_put(_pad_pow2(up))
                 self._leaf_tables[i] = (len(table), dev)
             inputs['tab_%d' % i] = self._leaf_tables[i][1]
             if i not in self._ctabs:
@@ -531,38 +562,84 @@ class DeviceScan(VectorScan):
             if self._programs is None:
                 self._programs = {}
             self._programs[pn] = progs
-        run_scatter, run_pallas = progs
+        run_scatter, run_pallas, acc_init = progs
         from .ops import pallas_kernels as pk
         use_pallas = run_pallas is not None and \
             pk.should_use(ns, total_w)
         run = run_pallas if use_pallas else run_scatter
-        outs = run(inputs)
-
-        meta = {
-            'caps': tuple(new_caps),
-            'cols': [(p.kind, p.lo,
-                      p.column.dict.values if p.kind == 'str' else None)
-                     for p in self._plans],
-            'ns': ns,
-        }
-        self._buffer.append((meta, outs))
-        self._buffer_bytes += ns * 8 + 64
-        if self._buffer_bytes > MAX_BUFFER_BYTES or \
-                len(self._buffer) > MAX_BUFFER_BATCHES:
-            self._flush()
+        if self._acc is None:
+            self._acc = acc_init()
+            self._acc_meta = {
+                'caps': tuple(new_caps),
+                'cols': [(p.kind, p.lo) for p in self._plans],
+                'ns': ns,
+            }
+            self._acc_batch = 0
+        inputs['base'] = np.int64(self._acc_batch << 32)
+        self._acc = run(inputs, self._acc)
+        self._acc_batch += 1
         return True
 
     # -- the device program -------------------------------------------------
 
+    def _program_key(self, caps, n):
+        """Canonical static structure of the device program: two scans
+        with equal keys trace to identical programs, so the jitted
+        callables (and their XLA executables) are shared via
+        _PROGRAM_CACHE."""
+        plans = tuple((p.kind, p.name, p.field, p.step, p.lo,
+                       p.host_translate) for p in self._plans)
+        leaves = tuple(
+            (key, self._num_plans[i])
+            for i, (key, _) in enumerate(self._leaf_list))
+        return (
+            n, tuple(caps), plans, leaves,
+            jsv.json_stringify(self.ds_pred.ast)
+            if self.ds_pred is not None else None,
+            jsv.json_stringify(self.user_pred.ast)
+            if self.user_pred is not None else None,
+            self.time_bounds,
+            tuple(sorted(s['name'] for s in self.synthetic)),
+            len(self._counter_spec),
+        )
+
     def _build_programs(self, caps, n):
+        key = self._program_key(caps, n)
+        cached = _PROGRAM_CACHE.get(key)
+        if cached is not None:
+            return cached
+        progs = self._trace_programs(caps, n)
+        if len(_PROGRAM_CACHE) >= 64:
+            # bounded: evict oldest (dict preserves insertion order);
+            # re-tracing is cheap next to the XLA compile, which the
+            # persistent compilation cache still remembers
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+        _PROGRAM_CACHE[key] = progs
+        return progs
+
+    def _trace_programs(self, caps, n):
         jax, jnp = get_jax()
         from . import native as mod_native
         mn = mod_native
         from .ops import pallas_kernels as pk
 
-        plans = self._plans
+        # Freeze the per-plan statics NOW: the cached lambdas re-trace
+        # whenever an input shape grows (e.g. a translate table crossing
+        # a power of two), and by then the live _KeyPlan objects may
+        # have mutated (window lo, host_translate) — the frozen copies
+        # keep every retrace faithful to this program's cache key.
+        import collections
+        _P = collections.namedtuple(
+            '_P', 'kind name field step lo host_translate')
+        plans = [_P(p.kind, p.name, p.field, p.step, p.lo,
+                    p.host_translate) for p in self._plans]
         leaf_index = {key: i for i, (key, _) in
                       enumerate(self._leaf_list)}
+        # leaf fields captured by value: the cached lambdas must not
+        # close over `self` (a global cache entry would otherwise pin
+        # the whole first scan instance — aggregator, dictionaries and
+        # device tables included — for the life of the process)
+        leaf_fields = [leaf.field for _, leaf in self._leaf_list]
         num_plans = self._num_plans
         time_bounds = self.time_bounds
         has_synth = bool(self.synthetic)
@@ -576,8 +653,7 @@ class DeviceScan(VectorScan):
 
         def leaf_out(key, args):
             i = leaf_index[key]
-            _, leaf = self._leaf_list[i]
-            f = leaf.field
+            f = leaf_fields[i]
             tags = args['tags_' + f]
             out = args['ctab_%d' % i][tags]
             out = jnp.where(tags == mn.TAG_STRING,
@@ -733,53 +809,93 @@ class DeviceScan(VectorScan):
                                             num_segments=ns + 1)[:ns]
             return dense, first, cvec
 
-        run_scatter = jax.jit(lambda args: body(args, False))
+        ncnt = len(self._counter_spec)
+        acc_ns = max(ns, 1)
+
+        def fold(args, acc, use_pallas):
+            """One batch folded into the device-resident accumulator:
+            dense weights and counters add; the first-occurrence key
+            takes a running min over (batch_base | row), which orders
+            keys exactly as the host engine inserts them (batch
+            submission order, then first row within the batch)."""
+            dense, first, cvec = body(args, use_pallas)
+            i64 = jnp.int64
+            bfirst = jnp.where(
+                first < I32MAX,
+                args['base'] + first.astype(i64),
+                i64(I64MAX))
+            return (acc[0] + dense.astype(i64),
+                    jnp.minimum(acc[1], bfirst),
+                    acc[2] + cvec.astype(i64))
+
+        run_scatter = jax.jit(lambda args, acc: fold(args, acc, False))
         run_pallas = None
         if pk.pallas_ok(ns) and pk.available():
-            run_pallas = jax.jit(lambda args: body(args, True))
-        return run_scatter, run_pallas
+            run_pallas = jax.jit(lambda args, acc: fold(args, acc, True))
+
+        init_key = (acc_ns, ncnt)
+        acc_init = _ACC_INIT_CACHE.get(init_key)
+        if acc_init is None:
+            def make_init(ns_, ncnt_):
+                jx, jn = get_jax()
+                return jx.jit(lambda: (
+                    jn.zeros((ns_,), dtype=jn.int64),
+                    jn.full((ns_,), I64MAX, dtype=jn.int64),
+                    jn.zeros((ncnt_,), dtype=jn.int64)))
+            acc_init = make_init(acc_ns, ncnt)
+            _ACC_INIT_CACHE[init_key] = acc_init
+        return run_scatter, run_pallas, acc_init
 
     # -- flush: fetch + ordered merge ---------------------------------------
 
     def _flush(self):
-        if not self._buffer:
+        """Fetch the device accumulator (one round trip for the whole
+        epoch: the copies are issued async and then awaited together)
+        and merge it into the insertion-ordered Aggregator."""
+        if self._acc is None:
             return
-        buf = self._buffer
-        self._buffer = []
-        self._buffer_bytes = 0
-        spec = self._counter_spec
-        for meta, outs in buf:
-            dense = np.asarray(outs[0])
-            first = np.asarray(outs[1])
-            cvec = np.asarray(outs[2])
-            for (stage, name, always), v in zip(spec, cvec):
-                v = int(v)
-                if always or v:
-                    stage.bump(name, v)
-            if not meta['cols']:
-                self.aggr.write_key((), self._weight(float(dense[0])))
-                continue
-            occurred = np.nonzero(first < I32MAX)[0]
-            if len(occurred) == 0:
-                continue
-            order = np.argsort(first[occurred], kind='stable')
-            segs = occurred[order]
-            rem = segs.copy()
-            caps = meta['caps']
-            col_codes = [None] * len(caps)
-            for ci in range(len(caps) - 1, -1, -1):
-                col_codes[ci] = rem % caps[ci]
-                rem = rem // caps[ci]
-            # global codes for the shared emit path: device string codes
-            # are already engine-dictionary codes; bucket codes offset
-            # by the window origin give raw ordinals
-            gcols = []
-            for (kind, lo, values), cc in zip(meta['cols'], col_codes):
-                if kind == 'str':
-                    gcols.append(np.asarray(cc, dtype=np.int64))
-                else:
-                    gcols.append(np.asarray(cc, dtype=np.int64) + lo)
-            self._emit_unique(gcols, dense[segs].astype(np.float64))
+        acc = self._acc
+        meta = self._acc_meta
+        self._acc = None
+        self._acc_meta = None
+        self._acc_batch = 0
+        for a in acc:
+            if hasattr(a, 'copy_to_host_async'):
+                try:
+                    a.copy_to_host_async()
+                except Exception:
+                    pass
+        dense = np.asarray(acc[0])
+        first = np.asarray(acc[1])
+        cvec = np.asarray(acc[2])
+        for (stage, name, always), v in zip(self._counter_spec, cvec):
+            v = int(v)
+            if always or v:
+                stage.bump(name, v)
+        if not meta['cols']:
+            self.aggr.write_key((), self._weight(float(dense[0])))
+            return
+        occurred = np.nonzero(first < I64MAX)[0]
+        if len(occurred) == 0:
+            return
+        order = np.argsort(first[occurred], kind='stable')
+        segs = occurred[order]
+        rem = segs.copy()
+        caps = meta['caps']
+        col_codes = [None] * len(caps)
+        for ci in range(len(caps) - 1, -1, -1):
+            col_codes[ci] = rem % caps[ci]
+            rem = rem // caps[ci]
+        # global codes for the shared emit path: device string codes
+        # are already engine-dictionary codes; bucket codes offset
+        # by the window origin give raw ordinals
+        gcols = []
+        for (kind, lo), cc in zip(meta['cols'], col_codes):
+            if kind == 'str':
+                gcols.append(np.asarray(cc, dtype=np.int64))
+            else:
+                gcols.append(np.asarray(cc, dtype=np.int64) + lo)
+        self._emit_unique(gcols, dense[segs].astype(np.float64))
 
 
 class AutoDeviceScan(DeviceScan):

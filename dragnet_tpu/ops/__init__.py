@@ -32,8 +32,29 @@ def get_jax():
     global _jax
     if _jax is None:
         try:
+            import os
             import jax
             jax.config.update('jax_enable_x64', True)
+            if os.environ.get('DN_XLA_CACHE', '1') != '0':
+                # persistent XLA compile cache: a CLI process pays the
+                # ~1-2s XLA compile of the scan program only once per
+                # (query shape, backend), not per invocation
+                try:
+                    cache_dir = os.environ.get('DN_XLA_CACHE_DIR') or \
+                        os.path.join(os.path.expanduser('~'), '.cache',
+                                     'dragnet_tpu', 'xla')
+                    jax.config.update('jax_compilation_cache_dir',
+                                      cache_dir)
+                    # cache real compiles (the ~1-2s scan programs)
+                    # but not every sub-millisecond variant — the
+                    # persistent cache has no eviction of its own
+                    jax.config.update(
+                        'jax_persistent_cache_min_compile_time_secs',
+                        0.2)
+                    jax.config.update(
+                        'jax_persistent_cache_min_entry_size_bytes', -1)
+                except Exception:
+                    pass
             import jax.numpy as jnp
             _jax = (jax, jnp)
         except Exception:
