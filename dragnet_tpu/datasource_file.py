@@ -206,11 +206,21 @@ class DatasourceFile(object):
 
         nworkers = scan_mt.scan_threads()
         use_mt = nworkers > 0 and scan_cls is VectorScan
+        # auto-device mode runs the MT host engine too: workers are
+        # plain VectorScans, and the device path (the main scanner) can
+        # TAKE OVER the stream mid-flight once its background backend
+        # probe succeeds and enough work remains — or hand back if it
+        # loses its probation window.  (Round 3 pinned auto to the
+        # single-threaded path, so auto regressed vs DN_ENGINE=host on
+        # multicore hosts before the device ever helped.)
+        auto_mt = nworkers > 0 and \
+            getattr(scan_cls, 'AUTO_STREAM', False)
+        progress_fn = getattr(scanner, 'set_progress', None)
 
-        if use_mt:
+        if use_mt or auto_mt:
             def build_worker(wp):
-                wscan = scan_cls(query, self.ds_timefield, wp,
-                                 ds_filter=self.ds_filter)
+                wscan = VectorScan(query, self.ds_timefield, wp,
+                                   ds_filter=self.ds_filter)
                 # workers drain per batch through the recorder; the
                 # deferred columnar merge would hold rows past drain
                 wscan._defer_enabled = False
@@ -231,25 +241,40 @@ class DatasourceFile(object):
                 for keys, value in calls:
                     scanner.aggr.write_key(keys, value)
 
-            ex = scan_mt.MTScanExecutor(nworkers, build_worker,
-                                        apply_result, pipeline,
-                                        stage_offset)
+            def new_executor():
+                return scan_mt.MTScanExecutor(nworkers, build_worker,
+                                              apply_result, pipeline,
+                                              stage_offset)
 
-            def flush():
-                n = parser.batch_size()
-                if n == 0:
-                    return
+            def device_batch(src, n):
+                nlines, nbad = parser.counters()
+                _bump_parse_counters(parser_stage, adapter_stage,
+                                     nlines, nbad, n)
+                weights = _batch_weights(skinner, parser, n)
+                scanner.write_native_batch(src, weights)
+                parser.reset_batch()
+                if scanner._disabled:
+                    scanner._flush()
+                    return False     # hand back to the MT executor
+                return True
+
+            def submit_batch(ex, n):
                 snap = scan_mt.ParserSnapshot(parser, paths, hints,
                                               dicts)
                 parser.reset_batch()
                 _bump_parse_counters(parser_stage, adapter_stage,
                                      snap.nlines, snap.nbad, n)
+                if auto_mt:
+                    scanner.note_external_batch(n)
                 ex.submit(snap)
 
-            try:
-                self._stream_native(files, parser, flush, BATCH_SIZE)
-            finally:
-                ex.finish()
+            self._takeover_stream(
+                files, parser, BATCH_SIZE, progress_fn, new_executor,
+                submit_batch,
+                scanner.take_over_now if auto_mt else None,
+                lambda: _RemappedParser(parser, remap) if skinner
+                else parser,
+                device_batch)
         else:
             # one provider for the whole scan so per-column caches
             # (decoded array values etc.) persist across batches
@@ -266,7 +291,8 @@ class DatasourceFile(object):
                 scanner.write_native_batch(src, weights)
                 parser.reset_batch()
 
-            self._stream_native(files, parser, flush, BATCH_SIZE)
+            self._stream_native(files, parser, flush, BATCH_SIZE,
+                                progress=progress_fn)
         # counters even when the final batch was empty
         nlines, nbad = parser.counters()
         if nlines:
@@ -410,10 +436,10 @@ class DatasourceFile(object):
                 self.raw_columns = {}
                 self.filter_fields = []
 
-        def make_scan_set(pl):
+        def make_scan_set(pl, cls):
             """The per-pipeline scan state: datasource predicate (+its
-            stage) and one VectorScan per metric; identical stage
-            layout on the main and every worker pipeline."""
+            stage) and one scan per metric; identical stage layout on
+            the main and every worker pipeline."""
             pred = stage = None
             if filter is not None:
                 holder = _Holder()
@@ -421,13 +447,17 @@ class DatasourceFile(object):
                 stage = pl.stage('Datasource filter')
             scans = []
             for q in queries:
-                s = scan_cls(q, self.ds_timefield, pl, ds_filter=None)
+                s = cls(q, self.ds_timefield, pl, ds_filter=None)
                 pl.stage('Add __dn_metric')
                 scans.append(s)
             return pred, stage, scans, holder if filter is not None \
                 else None
 
-        ds_pred, ds_stage, scanners, holder = make_scan_set(pipeline)
+        def make_scan_set_host(pl):
+            return make_scan_set(pl, VectorScan)
+
+        ds_pred, ds_stage, scanners, holder = make_scan_set(pipeline,
+                                                            scan_cls)
 
         skinner = fmt == 'json-skinner'
         proj = {}
@@ -467,9 +497,23 @@ class DatasourceFile(object):
             return alive0
 
         nworkers = scan_mt.scan_threads()
-        if nworkers > 0 and scan_cls is VectorScan:
+        use_mt = nworkers > 0 and scan_cls is VectorScan
+        # auto-device builds mirror the scan path: MT host workers by
+        # default, with a coordinated device takeover (and hand-back on
+        # lost probation) across all metric scanners
+        auto_mt = nworkers > 0 and \
+            getattr(scan_cls, 'AUTO_STREAM', False)
+
+        def set_all_progress(done, total):
+            for s in scanners:
+                if hasattr(s, 'set_progress'):
+                    s.set_progress(done, total)
+        progress_fn = set_all_progress \
+            if any(hasattr(s, 'set_progress') for s in scanners) else None
+
+        if use_mt or auto_mt:
             def build_worker(wp):
-                wpred, wstage, wscans, _ = make_scan_set(wp)
+                wpred, wstage, wscans, _ = make_scan_set_host(wp)
                 recs = []
                 for s in wscans:
                     s._defer_enabled = False   # drained per batch
@@ -499,25 +543,60 @@ class DatasourceFile(object):
                     for keys, value in calls:
                         s_main.aggr.write_key(keys, value)
 
-            ex = scan_mt.MTScanExecutor(nworkers, build_worker,
-                                        apply_result, pipeline,
-                                        stage_offset)
+            def new_executor():
+                return scan_mt.MTScanExecutor(nworkers, build_worker,
+                                              apply_result, pipeline,
+                                              stage_offset)
 
-            def flush():
-                n = parser.batch_size()
-                if n == 0:
-                    return
+            def take_over():
+                if not scanners[0].take_over_now():
+                    return False
+                # share the probe result so sibling scanners don't
+                # each wait on their own probe thread
+                for s in scanners[1:]:
+                    s._backend_ok = scanners[0]._backend_ok
+                return True
+
+            def device_batch(src, n):
+                nlines, nbad = parser.counters()
+                _bump_parse_counters(parser_stage, adapter_stage,
+                                     nlines, nbad, n)
+                provider = NativeColumns(src)
+                weights = _batch_weights(skinner, parser, n)
+                alive0 = None
+                if ds_pred is not None:
+                    alive0 = eval_ds_filter(ds_pred, ds_stage,
+                                            provider, n)
+                for s in scanners:
+                    s._process(provider, weights, alive=alive0)
+                parser.reset_batch()
+                if any(s._disabled for s in scanners):
+                    # coordinated hand-back: all metric scanners leave
+                    # the device together
+                    for s in scanners:
+                        s._flush()
+                        s._disabled = True
+                    return False
+                return True
+
+            def submit_batch(ex, n):
                 snap = scan_mt.ParserSnapshot(parser, paths, hints,
                                               dicts)
                 parser.reset_batch()
                 _bump_parse_counters(parser_stage, adapter_stage,
                                      snap.nlines, snap.nbad, n)
+                if auto_mt:
+                    for s in scanners:
+                        s.note_external_batch(n)
                 ex.submit(snap)
 
-            try:
-                self._stream_native(files, parser, flush, BATCH_SIZE)
-            finally:
-                ex.finish()
+            self._takeover_stream(
+                files, parser, BATCH_SIZE, progress_fn, new_executor,
+                submit_batch,
+                take_over if auto_mt else None,
+                lambda: _RemappedParser(parser, remap) if skinner
+                else parser,
+                device_batch)
         else:
             # one provider object per build so per-column caches persist
             src = _RemappedParser(parser, remap) if skinner else parser
@@ -539,7 +618,8 @@ class DatasourceFile(object):
                     s._process(provider, weights, alive=alive0)
                 parser.reset_batch()
 
-            self._stream_native(files, parser, flush, BATCH_SIZE)
+            self._stream_native(files, parser, flush, BATCH_SIZE,
+                                progress=progress_fn)
         nlines, nbad = parser.counters()
         if nlines:
             parser_stage.counters['ninputs'] = nlines
@@ -548,17 +628,70 @@ class DatasourceFile(object):
                 parser_stage.counters['invalid json'] = nbad
         return scanners
 
-    def _stream_native(self, files, parser, flush, batch_size):
+    def _takeover_stream(self, files, parser, batch_size, progress,
+                         new_executor, submit_batch, take_over,
+                         make_device_src, device_batch):
+        """The MT-host / device takeover state machine shared by scan
+        and build: batches go to the MT executor until take_over()
+        (auto mode's escalation decision) fires, then to the device
+        scanner(s) via device_batch; a False from device_batch (lost
+        probation) drains back to a fresh MT executor.  Batch order —
+        and therefore the aggregator's insertion order — is preserved
+        across both transitions: the executor is fully drained before
+        any device batch flushes, and the device accumulator is flushed
+        before the next executor starts."""
+        state = {'ex': new_executor(), 'src': None}
+
+        def flush():
+            n = parser.batch_size()
+            if n == 0:
+                return
+            if state['ex'] is not None and take_over is not None and \
+                    take_over():
+                state['ex'].finish()
+                state['ex'] = None
+                state['src'] = make_device_src()
+            if state['ex'] is None:
+                if not device_batch(state['src'], n):
+                    state['src'] = None
+                    state['ex'] = new_executor()
+                return
+            submit_batch(state['ex'], n)
+
+        try:
+            self._stream_native(files, parser, flush, batch_size,
+                                progress=progress)
+        finally:
+            if state['ex'] is not None:
+                state['ex'].finish()
+
+    def _stream_native(self, files, parser, flush, batch_size,
+                       progress=None):
         """Feed the concatenated file bytes to the native parser,
         flushing a batch whenever enough records accumulate (partial
         trailing lines join across file boundaries — catstreams
         semantics).  The bulk of each read chunk is parsed in place
-        (zero-copy span); only the carry-spanning line is stitched."""
+        (zero-copy span); only the carry-spanning line is stitched.
+
+        progress(bytes_done, bytes_total), when given, is called before
+        each flush — auto mode's device-switch heuristic estimates
+        remaining work from it (total is 0 when sizes are unknowable,
+        e.g. character devices)."""
         # larger reads amortize the multithreaded parse's fork/join; the
         # cap bounds how far a batch can overshoot the flush threshold
-        # (flush is only checked between reads)
+        # (flush is only checked between reads).  DN_READ_SIZE overrides
+        # (testing / IO tuning).
         readsz = min(1 << 24, (1 << 22) * getattr(parser, 'nthreads', 1))
+        try:
+            readsz = int(os.environ.get('DN_READ_SIZE', 0)) or readsz
+        except ValueError:
+            pass
         parse_at = getattr(parser, 'parse_at', None)
+        total = 0
+        for path, st in files:
+            sz = getattr(st, 'st_size', 0) if st is not None else 0
+            total += sz if sz and sz > 0 else 0
+        done = 0
         carry = b''
         for path, st in files:
             with open(path, 'rb') as f:
@@ -566,6 +699,7 @@ class DatasourceFile(object):
                     chunk = f.read(readsz)
                     if not chunk:
                         break
+                    done += len(chunk)
                     nl = chunk.rfind(b'\n')
                     if nl == -1:
                         carry += chunk
@@ -584,9 +718,13 @@ class DatasourceFile(object):
                                      nl + 1 - start)
                     carry = chunk[nl + 1:]
                     if parser.batch_size() >= batch_size:
+                        if progress is not None:
+                            progress(done, total)
                         flush()
         if carry:
             parser.parse(carry)
+        if progress is not None:
+            progress(done, total)
         flush()
 
     def _index_write(self, metrics, interval, tagged_points):

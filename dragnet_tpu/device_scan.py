@@ -35,6 +35,7 @@ buffer so insertion order survives.  Differential tests pin
 DeviceScan == VectorScan == StreamScan.
 """
 
+import threading
 import time
 
 import numpy as np
@@ -53,6 +54,11 @@ I32MAX = 2 ** 31 - 1
 NUM_FALSE, NUM_TRUE, NUM_EQ, NUM_NE, NUM_LE, NUM_GE = range(6)
 
 I64MAX = 2 ** 63 - 1
+
+# dispatch barrier interval: how many async device batches may be in
+# flight before the submitting thread waits for the accumulator (a
+# block, not a fetch) — bounds pinned input-buffer memory
+SYNC_EVERY_BATCHES = 32
 
 # jitted scan programs are shared across DeviceScan instances (a CLI
 # `dn scan` and the bench's repeat runs would otherwise re-trace and
@@ -182,6 +188,10 @@ class DeviceScan(VectorScan):
     REQUIRE_ACCELERATOR = False
     PROBATION_RECORDS = 0
     PROBATION_SECONDS = 0.25
+    # whether the datasource should run the MT host executor and let
+    # this scanner take the stream over mid-flight (auto mode only;
+    # forced mode owns the stream from the first batch)
+    AUTO_STREAM = False
 
     def __init__(self, query, time_field, pipeline, ds_filter=None):
         VectorScan.__init__(self, query, time_field, pipeline,
@@ -193,6 +203,10 @@ class DeviceScan(VectorScan):
         self._t0 = None
         self._probation = None    # None=not started, tuple=timing, False=done
         self._disabled = False
+        self._escalated = False
+        self._probe_thread = None
+        self._probe_result = None
+        self._progress = None     # (bytes_done, bytes_total) from stream
         self._plans = None            # built lazily from the query
         self._epoch_sig = None
         self._programs = None
@@ -292,7 +306,8 @@ class DeviceScan(VectorScan):
         n = provider.n
         self._records_seen += n
         if not self._disabled and \
-                self._records_seen > self.ESCALATE_RECORDS:
+                self._records_seen > self.ESCALATE_RECORDS and \
+                self._engage_device():
             if self._try_device(provider, weights, alive):
                 self._after_device_batch(n)
                 return
@@ -300,13 +315,51 @@ class DeviceScan(VectorScan):
         self._host_records += n
         VectorScan._process(self, provider, weights, alive=alive)
 
-    def _probe_backend(self):
-        """One-time lazy backend probe (first batch past the escalation
-        threshold).  False permanently disables the device path."""
+    def set_progress(self, bytes_done, bytes_total):
+        """Stream-progress hook (the file datasource reports bytes
+        consumed vs total): lets auto mode estimate remaining work
+        before committing to a device switch."""
+        self._progress = (bytes_done, bytes_total)
+
+    def note_external_batch(self, n):
+        """A batch of n records was processed outside this scanner (the
+        multithreaded host executor); counts toward escalation
+        thresholds and the observed host rate."""
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+        self._records_seen += n
+        self._host_records += n
+
+    def take_over_now(self):
+        """Whether the device path should take over the batch stream
+        from the multithreaded host executor (auto mode integration;
+        see datasource_file._scan_native)."""
+        return (not self._disabled and
+                self._records_seen > self.ESCALATE_RECORDS and
+                self._engage_device())
+
+    def _engage_device(self):
+        """Forced mode: probe the backend synchronously on the first
+        candidate batch (the caller asked for the device; blocking on
+        its initialization is expected)."""
+        if self._backend_ok is None and not self._probe_backend():
+            return False
+        return self._backend_ok
+
+    def _probe_ok(self):
+        """Pure backend-eligibility check (initializes the backend, no
+        scan-state mutation) — the single definition shared by the
+        synchronous (forced) and background (auto) probes."""
         ok = backend_ready()
         if ok and self.REQUIRE_ACCELERATOR:
             from .ops import is_accelerator
             ok = is_accelerator()
+        return bool(ok)
+
+    def _probe_backend(self):
+        """One-time lazy backend probe (first batch past the escalation
+        threshold).  False permanently disables the device path."""
+        ok = self._probe_ok()
         self._backend_ok = ok
         if not ok:
             self._disabled = True
@@ -578,6 +631,11 @@ class DeviceScan(VectorScan):
         inputs['base'] = np.int64(self._acc_batch << 32)
         self._acc = run(inputs, self._acc)
         self._acc_batch += 1
+        if self._acc_batch % SYNC_EVERY_BATCHES == 0:
+            # periodic dispatch barrier (no fetch): bounds how far the
+            # host can race ahead of the device, and so how many padded
+            # input buffers are pinned by in-flight executions
+            self._sync_device()
         return True
 
     # -- the device program -------------------------------------------------
@@ -905,11 +963,71 @@ class AutoDeviceScan(DeviceScan):
     path mid-stream (host-processed batches were merged immediately,
     so insertion order is preserved), and a probation window
     de-escalates if the device turns out slower than the host
-    (crossover detection)."""
+    (crossover detection).
+
+    Unlike forced mode, auto NEVER blocks the stream on device
+    initialization: the backend probe (which can take many seconds
+    over a tunneled device plugin) runs on a background thread while
+    the host engine keeps scanning, and the switch happens only once
+    the probe has succeeded AND the stream's byte progress suggests
+    enough work remains to amortize the program compile — so a scan
+    too small to benefit runs exactly like DN_ENGINE=host."""
 
     ESCALATE_RECORDS = 1 << 19
     REQUIRE_ACCELERATOR = True
-    PROBATION_RECORDS = 1 << 20
+    PROBATION_RECORDS = 1 << 17
+    AUTO_STREAM = True
+    # minimum estimated remaining host-engine seconds to justify the
+    # switch (covers compile + retrace + probation overhead)
+    MIN_REMAINING_SECONDS = 3.0
+    # without a size hint (stdin pipes), switch only deep into a stream
+    UNKNOWN_SIZE_RECORDS = 4 << 20
+
+    def _engage_device(self):
+        if self._escalated:
+            return bool(self._backend_ok)
+        if self._backend_ok is None:
+            if self._probe_thread is None:
+                self._probe_thread = threading.Thread(
+                    target=self._async_probe, daemon=True)
+                self._probe_thread.start()
+            result = self._probe_result
+            if result is None:
+                return False     # still probing; host path continues
+            self._probe_thread = None
+            self._backend_ok = result
+            if not result:
+                self._disabled = True
+                return False
+        if not self._backend_ok or not self._worth_switching():
+            return False
+        self._escalated = True
+        return True
+
+    def _async_probe(self):
+        """Background backend probe; publishes a bool to
+        _probe_result (single assignment, read by the stream thread)."""
+        try:
+            self._probe_result = self._probe_ok()
+        except Exception:
+            self._probe_result = False
+
+    def _worth_switching(self):
+        """Estimated remaining host-engine time exceeds the switch
+        overhead.  Uses the stream's byte progress when available;
+        falls back to a deep-stream record threshold."""
+        if self._t0 is None or not self._records_seen:
+            return False
+        elapsed = time.monotonic() - self._t0
+        if elapsed <= 0:
+            return False
+        rate = self._records_seen / elapsed
+        prog = self._progress
+        if prog and prog[0] > 0 and prog[1] > 0:
+            est_total = self._records_seen * (prog[1] / prog[0])
+            remaining = max(0.0, est_total - self._records_seen)
+            return remaining / rate >= self.MIN_REMAINING_SECONDS
+        return self._records_seen >= self.UNKNOWN_SIZE_RECORDS
 
 
 def scan_class():

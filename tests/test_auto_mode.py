@@ -1,0 +1,202 @@
+"""Auto-mode escalation mechanics: the device path takes over the
+batch stream from the multithreaded host executor mid-flight, and hands
+back when it loses its probation window — with results byte-identical
+to the host engine either way (the reference has no analog: its one
+engine is the per-record stream chain, lib/stream-scan.js:40-96; auto
+routing is this framework's addition and must never change results)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import query as mod_query            # noqa: E402
+from dragnet_tpu import device_scan                   # noqa: E402
+from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
+
+QUERY = {
+    'breakdowns': [
+        {'name': 'host'},
+        {'name': 'req.method'},
+        {'name': 'latency', 'aggr': 'quantize'},
+    ],
+    'filter': {'ne': ['res.statusCode', 599]},
+}
+
+NRECORDS = 4000
+
+
+def _gen_file(tmp_path):
+    import importlib.machinery
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'mktestdata')
+    spec = importlib.util.spec_from_file_location(
+        'mktestdata', path,
+        loader=importlib.machinery.SourceFileLoader('mktestdata', path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mindate_ms = int(mod.MINDATE.timestamp() * 1000)
+    maxdate_ms = int(mod.MAXDATE.timestamp() * 1000)
+    p = tmp_path / 'auto.log'
+    with open(p, 'w') as f:
+        for i in range(NRECORDS):
+            f.write(json.dumps(
+                mod.make_record(i, NRECORDS, mindate_ms, maxdate_ms),
+                separators=(',', ':')) + '\n')
+    return str(p)
+
+
+def _scan(datafile, cls_override, monkeypatch, threads='2'):
+    """Run a DatasourceFile scan with the scan class pinned."""
+    from dragnet_tpu import native as mod_native
+    if mod_native.get_lib() is None:
+        pytest.skip('native parser unavailable')
+    monkeypatch.setenv('DN_SCAN_THREADS', threads)
+    # small reads => many flush points, so the stream offers the
+    # escalation logic plenty of decision opportunities
+    monkeypatch.setenv('DN_READ_SIZE', '32768')
+    monkeypatch.delenv('DN_ENGINE', raising=False)
+    instances = []
+
+    class Recorder(cls_override):
+        def __init__(self, *args, **kwargs):
+            cls_override.__init__(self, *args, **kwargs)
+            instances.append(self)
+
+    # pre-warm the backend so the async probe resolves within this
+    # short stream (a real stream is many seconds long; this one is ms)
+    from dragnet_tpu import ops
+    ops.backend_ready()
+
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile},
+        'ds_filter': None,
+        'ds_format': 'json',
+    })
+    monkeypatch.setattr(DatasourceFile, '_vector_scan_cls',
+                        lambda self: Recorder)
+    result = ds.scan(mod_query.query_load(QUERY))
+    return result, instances
+
+
+def _host_points(datafile, monkeypatch):
+    monkeypatch.setenv('DN_ENGINE', 'host')
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile},
+        'ds_filter': None,
+        'ds_format': 'json',
+    })
+    pts = ds.scan(mod_query.query_load(QUERY)).points
+    monkeypatch.delenv('DN_ENGINE', raising=False)
+    return pts
+
+
+@pytest.fixture(scope='module')
+def datafile(tmp_path_factory):
+    return _gen_file(tmp_path_factory.mktemp('auto'))
+
+
+def test_mt_takeover_identical_results(datafile, monkeypatch):
+    """The device path takes over mid-stream from the MT executor and
+    the merged output is byte-identical to the host engine."""
+
+    class Eager(device_scan.AutoDeviceScan):
+        ESCALATE_RECORDS = 256
+        REQUIRE_ACCELERATOR = False     # CPU test backend
+        MIN_REMAINING_SECONDS = 0.0
+        UNKNOWN_SIZE_RECORDS = 0
+
+    # small batches so the stream has many flush points
+    import dragnet_tpu.engine as eng
+    monkeypatch.setattr(device_scan, 'BATCH_SIZE', 256)
+    monkeypatch.setattr(eng, 'BATCH_SIZE', 256)
+
+    expected = _host_points(datafile, monkeypatch)
+    result, instances = _scan(datafile, Eager, monkeypatch)
+    assert result.points == expected
+    assert len(instances) == 1
+    s = instances[0]
+    # wait until the background probe decided, then confirm takeover
+    assert s._escalated, 'device path never took over the stream'
+    assert s._acc is None          # flushed by finish()
+
+
+def test_deescalation_returns_to_mt(datafile, monkeypatch):
+    """A device path slower than the observed host rate loses its
+    probation and the scan returns to the MT host executor — results
+    still identical."""
+
+    class Losing(device_scan.AutoDeviceScan):
+        ESCALATE_RECORDS = 256
+        REQUIRE_ACCELERATOR = False
+        MIN_REMAINING_SECONDS = 0.0
+        UNKNOWN_SIZE_RECORDS = 0
+        PROBATION_RECORDS = 1          # end probation asap
+        PROBATION_SECONDS = 0.0
+
+        def take_over_now(self):
+            rv = device_scan.AutoDeviceScan.take_over_now(self)
+            if rv:
+                # pretend the host engine was processing at an
+                # unbeatable rate before the switch
+                self._host_records = 10 ** 12
+            return rv
+
+    import dragnet_tpu.engine as eng
+    monkeypatch.setattr(device_scan, 'BATCH_SIZE', 256)
+    monkeypatch.setattr(eng, 'BATCH_SIZE', 256)
+
+    expected = _host_points(datafile, monkeypatch)
+    result, instances = _scan(datafile, Losing, monkeypatch)
+    assert result.points == expected
+    s = instances[0]
+    assert s._escalated          # it did switch...
+    assert s._disabled           # ...and was demoted
+
+
+def test_small_scan_never_switches(datafile, monkeypatch):
+    """When the progress estimate says the remaining work cannot repay
+    the switch cost, auto mode behaves exactly like the host engine."""
+
+    class Reluctant(device_scan.AutoDeviceScan):
+        ESCALATE_RECORDS = 256
+        REQUIRE_ACCELERATOR = False
+        MIN_REMAINING_SECONDS = 1e9
+        UNKNOWN_SIZE_RECORDS = 1 << 60
+
+    expected = _host_points(datafile, monkeypatch)
+    result, instances = _scan(datafile, Reluctant, monkeypatch)
+    assert result.points == expected
+    s = instances[0]
+    assert not s._escalated
+    assert s._records_seen >= NRECORDS
+
+
+def test_nonmt_async_escalation(datafile, monkeypatch):
+    """DN_SCAN_THREADS=0 (no executor): the scanner itself escalates
+    via the async probe without ever blocking the stream."""
+
+    class Eager(device_scan.AutoDeviceScan):
+        ESCALATE_RECORDS = 256
+        REQUIRE_ACCELERATOR = False
+        MIN_REMAINING_SECONDS = 0.0
+        UNKNOWN_SIZE_RECORDS = 0
+
+    import dragnet_tpu.engine as eng
+    monkeypatch.setattr(device_scan, 'BATCH_SIZE', 256)
+    monkeypatch.setattr(eng, 'BATCH_SIZE', 256)
+
+    expected = _host_points(datafile, monkeypatch)
+    result, instances = _scan(datafile, Eager, monkeypatch, threads='0')
+    assert result.points == expected
+    s = instances[0]
+    # the async probe resolves quickly on the CPU backend; at least
+    # one later batch must have run on the device path
+    assert s._escalated
