@@ -172,16 +172,47 @@ def run_build_query(datafile, nrecords):
     return nrecords / build_s, times[len(times) // 2]
 
 
+def _timed_scan(datafile, nrecords, engine, repeats=2):
+    """Engine-pinned scan over datafile; best-of-N records/sec (the
+    same noise policy for every engine, so the side-by-side numbers in
+    BENCH_r*.json stay comparable)."""
+    prior = os.environ.get('DN_ENGINE')
+    if engine is None:
+        os.environ.pop('DN_ENGINE', None)
+    else:
+        os.environ['DN_ENGINE'] = engine
+    try:
+        best = float('inf')
+        for _ in range(repeats):
+            t0 = time.time()
+            result = run_scan(datafile, mod_query.query_load(QUERY))
+            best = min(best, time.time() - t0)
+    finally:
+        if prior is None:
+            os.environ.pop('DN_ENGINE', None)
+        else:
+            os.environ['DN_ENGINE'] = prior
+    return nrecords / best, len(result.points)
+
+
 def main():
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
+    # the large config exercises the device path (auto mode's escalation
+    # threshold sits at 512k records; the device needs batches to
+    # amortize dispatch): forced-device, forced-host and auto all run at
+    # this size so BENCH_r*.json captures the chip, the host engine, and
+    # the router's choice side by side
+    large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)
 
     import tempfile
 
     tmpdir = tempfile.mkdtemp(prefix='dn_bench_')
     datafile = os.path.join(tmpdir, 'bench.log')
+    largefile = os.path.join(tmpdir, 'bench_large.log')
     t0 = time.time()
     gen_to_file(nrecords, datafile)
+    gen_to_file(large_n, largefile)
     gen_s = time.time() - t0
     with open(datafile) as f:
         lines = [f.readline().rstrip('\n') for _ in range(host_sample)]
@@ -194,14 +225,26 @@ def main():
     # service)
     run_scan(datafile, q())
 
-    t0 = time.time()
-    result = run_scan(datafile, q())
-    vec_s = time.time() - t0
+    # best-of-3: the primary scan is a sub-second measurement whose
+    # run-to-run noise (page cache, allocator, CPU frequency) is
+    # comparable to the round-over-round drift being tracked
+    vec_s = float('inf')
+    for _ in range(3):
+        t0 = time.time()
+        result = run_scan(datafile, q())
+        vec_s = min(vec_s, time.time() - t0)
     npoints = len(result.points)
 
     t0 = time.time()
     run_host(lines[:host_sample], q())
     host_s = time.time() - t0
+
+    # the large-scan trio: vectorized host engine (no device routing),
+    # forced device, and the auto router's own choice
+    host_large_rps, np_host = _timed_scan(largefile, large_n, 'vector')
+    device_rps, np_dev = _timed_scan(largefile, large_n, 'jax')
+    auto_large_rps, np_auto = _timed_scan(largefile, large_n, None)
+    assert np_dev == np_auto == np_host, 'engine outputs diverge'
 
     build_rps, query_p50 = run_build_query(datafile, nrecords)
 
@@ -211,11 +254,12 @@ def main():
     sys.stderr.write(
         'bench: %d records, %d output points; gen %.1fs; '
         'dn-scan %.2fs (%.0f rec/s); host-sample %.2fs (%.0f rec/s); '
+        'large(%d): host %.0f, device %.0f, auto %.0f rec/s; '
         'dn-build %.0f rec/s; index-query p50 %.1fms; '
-        'engine=%s native=%s threads=%s\n'
+        'native=%s threads=%s\n'
         % (nrecords, npoints, gen_s, vec_s, vec_rps, host_s, host_rps,
+           large_n, host_large_rps, device_rps, auto_large_rps,
            build_rps, query_p50 * 1000,
-           os.environ.get('DN_ENGINE', 'auto'),
            os.environ.get('DN_NATIVE', '1'),
            os.environ.get('DN_SCAN_THREADS', 'auto')))
     import shutil
@@ -226,6 +270,14 @@ def main():
         'value': round(vec_rps),
         'unit': 'records/s',
         'vs_baseline': round(vec_rps / host_rps, 3),
+        'extra': {
+            'large_records': large_n,
+            'host_large_records_per_sec': round(host_large_rps),
+            'device_large_records_per_sec': round(device_rps),
+            'auto_large_records_per_sec': round(auto_large_rps),
+            'build_records_per_sec': round(build_rps),
+            'index_query_p50_ms': round(query_p50 * 1000, 2),
+        },
     }))
 
 

