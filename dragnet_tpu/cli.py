@@ -437,6 +437,19 @@ def dn_output(query, opts, result, dsname):
     pipeline = result.pipeline
 
     if result.dry_run_files is not None:
+        plan = getattr(result, 'dry_run_plan', None)
+        if plan is not None:
+            # cluster backend: the execution plan, then the inputs —
+            # the reference printed its Manta job JSON the same way
+            # (lib/datasource-manta.js:446-454)
+            import json as mod_json
+            partition = plan.get('partition', [])
+            head = {k: v for k, v in plan.items() if k != 'partition'}
+            sys.stderr.write(mod_json.dumps(head, indent=4) + '\n')
+            sys.stderr.write('\nInputs:\n')
+            for path in partition:
+                sys.stderr.write('%s\n' % path)
+            return
         sys.stderr.write('would scan files:\n')
         for path in result.dry_run_files:
             sys.stderr.write('    %s\n' % path)

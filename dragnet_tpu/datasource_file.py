@@ -41,6 +41,7 @@ class ScanResult(object):
         self.pipeline = pipeline
         self.points = points
         self.dry_run_files = dry_run_files
+        self.dry_run_plan = None    # cluster backend: execution plan
         self.query = query
 
 
@@ -847,7 +848,8 @@ class DatasourceFile(object):
         index_list = pipeline.stage('Index List')
         aggr = Aggregator(query,
                           stage=pipeline.stage('Index Result Aggregator'))
-        for path, st in files:
+
+        def query_one(path):
             try:
                 qi = open_index(path)
             except DNError as e:
@@ -859,7 +861,21 @@ class DatasourceFile(object):
                 raise DNError('index "%s" query' % path, cause=e)
             finally:
                 qi.close()
-            for fields, value in sub.points():
+            return sub.points()
+
+        # per-index-file fan-out at concurrency 10, merged in find
+        # order (the reference's vasync barrier did the same,
+        # lib/datasource-file.js:629-689); sequential for small trees
+        paths = [p for p, st in files]
+        conc = min(10, len(paths))
+        if conc > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=conc) as pool:
+                results = list(pool.map(query_one, paths))
+        else:
+            results = [query_one(p) for p in paths]
+        for pts in results:
+            for fields, value in pts:
                 index_list.bump('ninputs')
                 index_list.bump('noutputs')
                 aggr.write(fields, value)

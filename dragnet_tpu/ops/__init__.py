@@ -89,6 +89,14 @@ def backend_ready():
     return _backend_ready
 
 
+def backend_probed():
+    """The cached backend_ready() verdict WITHOUT probing: True/False
+    when a probe already ran this process, None when unknown.  For
+    informational paths (e.g. dry-run plans) that must never pay
+    backend initialization."""
+    return _backend_ready
+
+
 def platform_hint():
     """Cheap, non-backend-initializing guess at the jax platform: the
     first entry of JAX_PLATFORMS ('' when unset, meaning jax would

@@ -113,10 +113,14 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         (lib/datasource-manta.js:265-384) without job orchestration."""
         nprocs, pid = mod_dist.maybe_initialize()
         if nprocs <= 1 or dry_run:
-            return super(DatasourceCluster, self).build(
+            result = super(DatasourceCluster, self).build(
                 metrics, interval, time_after=time_after,
                 time_before=time_before, dry_run=dry_run,
                 warn_func=warn_func)
+            if dry_run:
+                result.dry_run_plan = self.execution_plan(
+                    result.dry_run_files)
+            return result
 
         # same argument validation as the single-process build; failing
         # here (on every process) beats a TypeError on process 0 and a
@@ -161,10 +165,63 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         result = super(DatasourceCluster, self).scan(
             query, dry_run=dry_run, warn_func=warn_func)
         nprocs, pid = mod_dist.maybe_initialize()
-        if dry_run or nprocs <= 1 or result.points is None:
+        if dry_run:
+            result.dry_run_plan = self.execution_plan(
+                result.dry_run_files)
+            return result
+        if nprocs <= 1 or result.points is None:
             return result
         result.points = _allgather_merge_points(query, result.points)
         return result
+
+    def query(self, query, interval, dry_run=False):
+        """Distributed index query: each process queries its partition
+        of the index files (the _find override), then the partial
+        aggregates merge across processes with the same allgather
+        points reduce as scan — mirroring the reference's one-map-task-
+        per-index-file queries (lib/datasource-manta.js:392-433)."""
+        result = super(DatasourceCluster, self).query(
+            query, interval, dry_run=dry_run)
+        nprocs, pid = mod_dist.maybe_initialize()
+        if dry_run:
+            result.dry_run_plan = self.execution_plan(
+                result.dry_run_files)
+            return result
+        if nprocs <= 1 or result.points is None:
+            return result
+        result.points = _allgather_merge_points(query, result.points)
+        return result
+
+    def execution_plan(self, partition_files):
+        """The serializable execution plan (the reference printed its
+        Manta job JSON on --dry-run, lib/datasource-manta.js:446-454):
+        process topology, this process's input partition, and the local
+        device mesh the sharded program would run over."""
+        nprocs, pid = mod_dist.maybe_initialize()
+        plan = {
+            'backend': 'cluster',
+            'phases': [
+                {'type': 'map',
+                 'exec': 'scan partition on local device mesh'},
+                {'type': 'reduce',
+                 'exec': 'allgather points merge across processes'},
+            ],
+            'nprocesses': nprocs,
+            'process': pid,
+            'partition': list(partition_files or []),
+        }
+        # informational only — must never pay backend initialization
+        # (over a tunneled device plugin the first probe can block for
+        # minutes; a dry run does no device execution)
+        from ..ops import backend_probed, get_jax, platform_hint
+        if backend_probed():
+            jax, _ = get_jax()
+            plan['mesh'] = {'axis': 'd', 'local_devices':
+                            [str(d) for d in jax.local_devices()]}
+        else:
+            plan['mesh'] = {'axis': 'd',
+                            'platform_hint': platform_hint() or 'auto'}
+        return plan
 
 
 def _allgather_merge_tagged(points):

@@ -1,5 +1,16 @@
-"""Worker for the multi-process cluster test: scans a dataset through
-the cluster datasource under jax.distributed and prints the points."""
+"""Worker for the multi-process cluster tests: runs one cluster
+datasource operation under jax.distributed and prints a JSON result
+line.  Modes:
+
+    scan DATADIR                 scan + allgather merge -> points
+    build DATADIR INDEXDIR       distributed daily index build
+    build_fail DATADIR BADPATH   build whose index write must fail on
+                                 process 0 WITHOUT hanging process 1
+                                 (the barrier-release contract,
+                                 parallel/cluster.py)
+    query DATADIR INDEXDIR       distributed index query (partitioned
+                                 index files + allgather merge)
+"""
 
 import json
 import os
@@ -8,27 +19,71 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
+QUERY = {'breakdowns': [
+    {'name': 'host'}, {'name': 'latency', 'aggr': 'quantize'}]}
+
+METRIC = {'name': 'm', 'datasource': 'd', 'breakdowns': [
+    {'name': 'timestamp', 'field': 'time', 'date': '',
+     'aggr': 'lquantize', 'step': 86400},
+    {'name': 'host', 'field': 'host'},
+    {'name': 'latency', 'field': 'latency', 'aggr': 'quantize'}]}
+
+
+def _ds(datadir, indexdir=None):
+    from dragnet_tpu.parallel import cluster
+    bc = {'path': datadir, 'timeField': 'time'}
+    if indexdir is not None:
+        bc['indexPath'] = indexdir
+    return cluster.DatasourceCluster({
+        'ds_backend': 'cluster',
+        'ds_backend_config': bc,
+        'ds_filter': None,
+        'ds_format': 'json',
+    })
+
 
 def main():
-    datadir = sys.argv[1]
+    mode = sys.argv[1]
+    datadir = sys.argv[2]
     import jax
     jax.config.update('jax_platforms', 'cpu')
 
     from dragnet_tpu import query as mod_query
-    from dragnet_tpu.parallel import cluster, distributed
+    from dragnet_tpu.parallel import distributed
 
     nprocs, pid = distributed.maybe_initialize()
-    ds = cluster.DatasourceCluster({
-        'ds_backend': 'cluster',
-        'ds_backend_config': {'path': datadir},
-        'ds_filter': None,
-        'ds_format': 'json',
-    })
-    q = mod_query.query_load({'breakdowns': [
-        {'name': 'host'}, {'name': 'latency', 'aggr': 'quantize'}]})
-    result = ds.scan(q)
-    print(json.dumps({'pid': pid, 'nprocs': nprocs,
-                      'points': result.points}))
+    out = {'pid': pid, 'nprocs': nprocs}
+
+    if mode == 'scan':
+        result = _ds(datadir).scan(mod_query.query_load(QUERY))
+        out['points'] = result.points
+    elif mode == 'build':
+        indexdir = sys.argv[3]
+        metric = mod_query.metric_deserialize(METRIC)
+        _ds(datadir, indexdir).build([metric], 'day')
+        built = []
+        for root, dirs, files in os.walk(indexdir):
+            for fn in sorted(files):
+                built.append(os.path.relpath(os.path.join(root, fn),
+                                             indexdir))
+        out['built'] = sorted(built)
+    elif mode == 'build_fail':
+        badpath = sys.argv[3]
+        metric = mod_query.metric_deserialize(METRIC)
+        try:
+            _ds(datadir, badpath).build([metric], 'day')
+            out['error'] = None
+        except Exception as e:
+            out['error'] = '%s: %s' % (type(e).__name__, e)
+    elif mode == 'query':
+        indexdir = sys.argv[3]
+        result = _ds(datadir, indexdir).query(
+            mod_query.query_load(QUERY), 'day')
+        out['points'] = result.points
+    else:
+        raise SystemExit('unknown mode %r' % mode)
+
+    print(json.dumps(out))
 
 
 if __name__ == '__main__':

@@ -9,7 +9,6 @@ addition and must never change results)."""
 import json
 import os
 import sys
-import time
 
 import pytest
 
@@ -19,7 +18,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from dragnet_tpu import query as mod_query            # noqa: E402
 from dragnet_tpu import device_scan                   # noqa: E402
 from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
-from dragnet_tpu.vpipe import Pipeline                # noqa: E402
 
 QUERY = {
     'breakdowns': [

@@ -185,3 +185,45 @@ def test_cluster_full_pipeline_sharded(tmp_path, monkeypatch):
     ndev = parse_n.counters.get('ndevicebatches', 0)
     assert ndev >= 4000 // 512, ndev
     assert parse_n.counters.get('nspillrecords', 0) == 0
+
+
+def test_cluster_dry_run_plan(tmp_path, capsys):
+    """--dry-run on the cluster backend prints the execution plan
+    (process topology, mesh, input partition) the way the reference
+    printed its Manta job JSON + inputs (lib/datasource-manta.js:
+    446-454)."""
+    import json
+    from dragnet_tpu import query as mod_query
+    from dragnet_tpu import cli as mod_cli
+    from dragnet_tpu.parallel import cluster
+
+    datadir = tmp_path / 'data'
+    datadir.mkdir()
+    with open(datadir / 'a.log', 'w') as f:
+        f.write('{"host":"a"}\n')
+
+    ds = cluster.DatasourceCluster({
+        'ds_backend': 'cluster',
+        'ds_backend_config': {'path': str(datadir)},
+        'ds_filter': None, 'ds_format': 'json',
+    })
+    q = mod_query.query_load({'breakdowns': [{'name': 'host'}]})
+    r = ds.scan(q, dry_run=True)
+    plan = r.dry_run_plan
+    assert plan['backend'] == 'cluster'
+    assert plan['nprocesses'] == 1 and plan['process'] == 0
+    assert plan['partition'] == [str(datadir / 'a.log')]
+    assert [p['type'] for p in plan['phases']] == ['map', 'reduce']
+    assert plan['mesh']['axis'] == 'd'
+    assert len(plan['mesh']['local_devices']) == 8
+
+    # the CLI rendering: plan JSON, then Inputs (reference flavor)
+    class Opts(object):
+        pass
+    mod_cli.dn_output(q, Opts(), r, 'ds')
+    err = capsys.readouterr().err
+    head, _, inputs = err.partition('\nInputs:\n')
+    parsed = json.loads(head)
+    assert parsed['backend'] == 'cluster'
+    assert 'partition' not in parsed      # moved to the Inputs section
+    assert inputs.splitlines() == [str(datadir / 'a.log')]
