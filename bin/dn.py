@@ -1,8 +1,11 @@
 #!/usr/bin/env python3
 """dn: dragnet-tpu command-line interface."""
 
-import os
-import sys
+import time as _time
+_T0 = _time.time()   # before any dragnet imports: the 'require' span
+
+import os   # noqa: E402
+import sys  # noqa: E402
 
 _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _root)
@@ -23,6 +26,8 @@ if os.environ.get('PYTHONPATH'):
             del os.environ['PYTHONPATH']
 
 from dragnet_tpu.cli import main  # noqa: E402
+_REQUIRE_S = _time.time() - _T0   # module-load cost (reference
+                                  # bin/dn:80-83 tracked the same span)
 
 # Lone surrogates (JSON \uD800-class escapes) must render rather than
 # crash; Node's utf-8 encoder emits U+FFFD for them (not '?', which is
@@ -50,7 +55,7 @@ for _stream in (sys.stdout, sys.stderr):
 
 if __name__ == '__main__':
     try:
-        rv = main()
+        rv = main(startup=(_T0, _REQUIRE_S))
     except KeyboardInterrupt:
         rv = 130
     try:

@@ -647,7 +647,10 @@ COMMANDS = {
 }
 
 
-def main(argv=None):
+def main(argv=None, startup=None):
+    """startup=(process_t0, require_seconds) from bin/dn.py lets -t
+    split module-load cost from total, like the reference's
+    require-vs-total timing (bin/dn:80-83,1290-1296)."""
     if argv is None:
         argv = sys.argv[1:]
 
@@ -658,6 +661,9 @@ def main(argv=None):
 
     import time
     t0 = time.time()
+    require_s = None
+    if startup is not None:
+        t0, require_s = startup[0], startup[1]
 
     try:
         if len(argv) < 1:
@@ -685,5 +691,7 @@ def main(argv=None):
 
     if track_time:
         sys.stderr.write('timing stats:\n')
+        if require_s is not None:
+            sys.stderr.write('    require:  %.3fs\n' % require_s)
         sys.stderr.write('    total:    %.3fs\n' % (time.time() - t0))
     return 0

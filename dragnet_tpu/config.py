@@ -120,13 +120,18 @@ class DragnetConfig(object):
         for dsname, ds in self.dc_datasources.items():
             bc = {k: v for k, v in ds['ds_backend_config'].items()
                   if v is not None}
-            rv['datasources'].append({
+            entry = {
                 'name': dsname,
                 'backend': ds['ds_backend'],
                 'backend_config': bc,
                 'filter': ds['ds_filter'],
-                'dataFormat': ds['ds_format'],
-            })
+            }
+            # JSON.stringify drops undefined values: an unset
+            # dataFormat is absent, not null (the schema types it as a
+            # string when present; reference bin/dn:348)
+            if ds['ds_format'] is not None:
+                entry['dataFormat'] = ds['ds_format']
+            rv['datasources'].append(entry)
             for metname, m in self.datasource_list_metrics(dsname):
                 rv['metrics'].append(mod_query.metric_serialize(m))
         return rv
@@ -141,17 +146,137 @@ def create_initial_config():
     })
 
 
+# --- schema validation (models lib/config-common.js:19-108, whose
+# jsprim.validateJsonObject wraps the json-schema library: the FIRST
+# violation becomes 'property "<path>": <reason>' with json-schema's
+# message strings — 'is missing and it is required' for a missing
+# required property, '<typeof> value found, but a <type> is required'
+# for a type mismatch) -------------------------------------------------
+
+def _js_typeof(v):
+    """JS typeof for the values JSON can produce (null and arrays are
+    'object', like typeof in JS)."""
+    if isinstance(v, bool):
+        return 'boolean'
+    if isinstance(v, (int, float)):
+        return 'number'
+    if isinstance(v, str):
+        return 'string'
+    return 'object'
+
+
+def _check_type(v, typ, path):
+    """json-schema checkType subset: 'string' | 'number' | 'object' |
+    'array'.  Mirrors the library's JS-typeof semantics: null passes an
+    'object' check (typeof null === 'object'), arrays do not."""
+    if typ == 'string':
+        ok = isinstance(v, str)
+    elif typ == 'number':
+        ok = isinstance(v, (int, float)) and not isinstance(v, bool)
+    elif typ == 'array':
+        ok = isinstance(v, list)
+    else:  # object
+        ok = v is None or isinstance(v, dict)
+    if ok:
+        return None
+    return 'property "%s": %s value found, but a %s is required' \
+        % (path, _js_typeof(v), typ)
+
+
+def _check_props(value, props, path):
+    """Validate an object's properties ((name, type, required) in
+    schema order); returns the first violation string or None."""
+    for name, typ, required in props:
+        p = path + '.' + name if path else name
+        if not isinstance(value, dict) or name not in value:
+            if required:
+                return 'property "%s": is missing and it is required' \
+                    % p
+            continue
+        err = _check_type(value[name], typ, p)
+        if err is not None:
+            return err
+    return None
+
+
+def _check_array_of_objects(value, items_props, path):
+    for i, item in enumerate(value):
+        p = '%s[%d]' % (path, i)
+        if not isinstance(item, dict):
+            return 'property "%s": %s value found, but a object is ' \
+                'required' % (p, _js_typeof(item))
+        err = _check_props(item, items_props, p)
+        if err is not None:
+            return err
+    return None
+
+
+_DS_PROPS = [
+    ('name', 'string', True),
+    ('backend', 'string', True),
+    ('backend_config', 'object', True),
+    ('filter', 'object', True),
+    ('dataFormat', 'string', False),
+]
+
+_BREAKDOWN_PROPS = [
+    ('name', 'string', True),
+    ('field', 'string', True),
+    ('date', 'string', False),
+    ('aggr', 'string', False),
+    ('step', 'number', False),
+]
+
+_METRIC_PROPS = [
+    ('name', 'string', True),
+    ('datasource', 'string', True),
+    ('filter', 'object', True),
+    ('breakdowns', 'array', True),
+]
+
+
+def _validate_config(inp):
+    """First schema violation of the whole document (the shape of
+    lib/config-common.js:27-108), or None.  (vmaj was already
+    gate-checked by the caller; the version gate runs first, like the
+    reference's base-schema + version sequence.)"""
+    err = _check_props(inp, [('vmin', 'number', True),
+                             ('datasources', 'array', True),
+                             ('metrics', 'array', True)], '')
+    if err is not None:
+        return err
+    err = _check_array_of_objects(inp['datasources'], _DS_PROPS,
+                                  'datasources')
+    if err is not None:
+        return err
+    for i, met in enumerate(inp['metrics']):
+        p = 'metrics[%d]' % i
+        if not isinstance(met, dict):
+            return 'property "%s": %s value found, but a object is ' \
+                'required' % (p, _js_typeof(met))
+        err = _check_props(met, _METRIC_PROPS, p)
+        if err is not None:
+            return err
+        err = _check_array_of_objects(met['breakdowns'],
+                                      _BREAKDOWN_PROPS,
+                                      p + '.breakdowns')
+        if err is not None:
+            return err
+    return None
+
+
 def load_config(inp):
     if not isinstance(inp, dict):
         return DNError('failed to load config: not an object')
     vmaj = inp.get('vmaj')
-    if vmaj != CONFIG_MAJOR:
+    if vmaj != CONFIG_MAJOR or isinstance(vmaj, bool):
+        shown = 'undefined' if 'vmaj' not in inp \
+            else jsv.to_string(vmaj)
         return DNError('failed to load config: major version ("%s") '
-                       'not supported' % jsv.to_string(vmaj))
-    for key in ('datasources', 'metrics'):
-        if not isinstance(inp.get(key), list):
-            return DNError('failed to load config: property "%s": '
-                           'required' % key)
+                       'not supported' % shown)
+    error = _validate_config(inp)
+    if error is not None:
+        return DNError('failed to load config: %s' % error)
 
     dc = DragnetConfig()
     for dsconfig in inp['datasources']:
@@ -164,8 +289,12 @@ def load_config(inp):
     for metconfig in inp['metrics']:
         dsname = metconfig['datasource']
         dc.dc_metrics.setdefault(dsname, {})
-        dc.dc_metrics[dsname][metconfig['name']] = \
-            mod_query.metric_deserialize(metconfig)
+        try:
+            metric = mod_query.metric_deserialize(metconfig)
+        except Exception as e:
+            return DNError('failed to load config: metric "%s": %s'
+                           % (metconfig.get('name'), e))
+        dc.dc_metrics[dsname][metconfig['name']] = metric
     return dc
 
 

@@ -18,6 +18,7 @@ import numpy as np
 
 from .errors import DNError
 from . import jsvalues as jsv
+from . import log as mod_log
 from . import query as mod_query
 from . import ingest as mod_ingest
 from . import find as mod_find
@@ -26,6 +27,8 @@ from .scan import StreamScan
 from .vpipe import Pipeline
 from .index_sink import make_index_sink
 from .index_query import open_index
+
+LOG = mod_log.get('datasource-file')
 
 
 def create_datasource(dsconfig):
@@ -119,6 +122,11 @@ class DatasourceFile(object):
             return ScanResult(pipeline,
                               dry_run_files=[p for p, st in files])
 
+        LOG.debug('scan start', datapath=self.ds_datapath,
+                  nfiles=len(files),
+                  nbytes=sum(getattr(st, 'st_size', 0) or 0
+                             for p, st in files))
+
         # The vectorized engine produces identical results; --warnings
         # needs the per-record host path for ordered warning output.
         # Within the vectorized path, ingest prefers the native C++
@@ -168,8 +176,10 @@ class DatasourceFile(object):
 
         if hasattr(scanner, 'finish'):
             scanner.finish()   # merge any device-buffered batches
-        return ScanResult(pipeline, points=scanner.aggr.points(),
-                          query=query)
+        points = scanner.aggr.points()
+        LOG.debug('scan done', npoints=len(points),
+                  engine=type(scanner).__name__)
+        return ScanResult(pipeline, points=points, query=query)
 
     def _scan_native(self, query, files, fmt, pipeline):
         """Scan via the C++ columnar parser: one pass over the
@@ -365,6 +375,11 @@ class DatasourceFile(object):
         if dry_run:
             return ScanResult(pipeline,
                               dry_run_files=[p for p, st in files])
+
+        LOG.debug('%s start' % ('build' if sink == 'index'
+                                else 'index-scan'),
+                  datapath=self.ds_datapath, nfiles=len(files),
+                  nmetrics=len(metrics), interval=interval)
 
         queries = [mod_query.metric_query(m, time_after, time_before,
                                           interval, self.ds_timefield)
@@ -867,6 +882,8 @@ class DatasourceFile(object):
         # order (the reference's vasync barrier did the same,
         # lib/datasource-file.js:629-689); sequential for small trees
         paths = [p for p, st in files]
+        LOG.debug('query start', indexroot=root, nindexes=len(paths),
+                  interval=interval)
         conc = min(10, len(paths))
         if conc > 1:
             from concurrent.futures import ThreadPoolExecutor

@@ -41,6 +41,7 @@ import time
 import numpy as np
 
 from . import jsvalues as jsv
+from . import log as mod_log
 from . import query as mod_query
 from .engine import (VectorScan, NativeColumns, MAX_DENSE_SEGMENTS,
                      BATCH_SIZE, engine_mode)
@@ -59,6 +60,20 @@ I64MAX = 2 ** 63 - 1
 # flight before the submitting thread waits for the accumulator (a
 # block, not a fetch) — bounds pinned input-buffer memory
 SYNC_EVERY_BATCHES = 32
+
+LOG = mod_log.get('device-scan')
+
+
+def _rate_field(r):
+    """Rates for log records: None when unknown, the float itself when
+    non-finite (round(inf) raises)."""
+    if r is None:
+        return None
+    try:
+        import math
+        return round(r) if math.isfinite(r) else r
+    except (TypeError, ValueError):
+        return r
 
 # jitted scan programs are shared across DeviceScan instances (a CLI
 # `dn scan` and the bench's repeat runs would otherwise re-trace and
@@ -362,6 +377,8 @@ class DeviceScan(VectorScan):
         """One-time lazy backend probe (first batch past the escalation
         threshold).  False permanently disables the device path."""
         ok = self._probe_ok()
+        LOG.debug('backend probe', ok=ok,
+                  records_seen=self._records_seen)
         self._backend_ok = ok
         if not ok:
             self._disabled = True
@@ -404,6 +421,15 @@ class DeviceScan(VectorScan):
         rate = seen / elapsed if elapsed > 0 else float('inf')
         if self._host_rate is not None and rate < self._host_rate:
             self._disabled = True
+            LOG.info('device de-escalated (lost probation)',
+                     device_rate=_rate_field(rate),
+                     host_rate=_rate_field(self._host_rate),
+                     window_records=seen,
+                     window_seconds=round(elapsed, 3))
+        else:
+            LOG.debug('device passed probation',
+                      device_rate=_rate_field(rate),
+                      host_rate=_rate_field(self._host_rate))
         self._probation = False
 
     def finish(self):
@@ -1198,20 +1224,32 @@ class AutoDeviceScan(DeviceScan):
         if ctx is not None:
             sp = self._shadow
             if sp is None:
+                LOG.debug('device audition started',
+                          records_seen=self._records_seen)
                 self._shadow = _ShadowProbe(*ctx)
                 return False
             if not sp.done:
                 return False
             if sp.failed or sp.rate is None:
+                LOG.info('device audition failed; staying on host')
                 self._disabled = True
                 return False
             hr = self._current_host_rate()
             if hr is not None and sp.rate < hr * self.SHADOW_MARGIN:
+                LOG.info('device lost audition; staying on host',
+                         device_rate=_rate_field(sp.rate),
+                         host_rate=_rate_field(hr),
+                         margin=self.SHADOW_MARGIN)
                 self._disabled = True
                 return False
             if hr is not None:
                 self._host_rate = hr   # probation baseline
+            LOG.info('device won audition; taking over stream',
+                     device_rate=_rate_field(sp.rate),
+                     host_rate=_rate_field(hr))
         self._escalated = True
+        LOG.info('escalated to device path',
+                 records_seen=self._records_seen)
         return True
 
     def _current_host_rate(self):

@@ -212,9 +212,11 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         }
         # informational only — must never pay backend initialization
         # (over a tunneled device plugin the first probe can block for
-        # minutes; a dry run does no device execution)
+        # minutes; a dry run does no device execution).  Multi-process
+        # runs already initialized the backend, so listing devices is
+        # free there.
         from ..ops import backend_probed, get_jax, platform_hint
-        if backend_probed():
+        if backend_probed() or nprocs > 1:
             jax, _ = get_jax()
             plan['mesh'] = {'axis': 'd', 'local_devices':
                             [str(d) for d in jax.local_devices()]}

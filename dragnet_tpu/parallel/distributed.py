@@ -24,7 +24,13 @@ _initialized = False
 
 def maybe_initialize():
     """Initialize jax.distributed when multi-host env vars are present.
-    Returns (num_processes, process_id)."""
+    Returns (num_processes, process_id).
+
+    Single-process (no coordinator configured and jax.distributed not
+    already initialized) returns (1, 0) WITHOUT touching the backend:
+    jax.process_count() initializes devices, which can block for
+    minutes over a tunneled device plugin — a cost that informational
+    callers (dry-run plans, file partitioning) must never pay."""
     global _initialized
     j = get_jax()
     if j is None:
@@ -40,10 +46,22 @@ def maybe_initialize():
                                    process_id=pid)
         _initialized = True
 
+    if not _initialized and not _jax_dist_initialized(jax):
+        return (1, 0)
+
     try:
         return (jax.process_count(), jax.process_index())
     except Exception:
         return (1, 0)
+
+
+def _jax_dist_initialized(jax):
+    """Whether jax.distributed was initialized by someone else (an
+    outer launcher); does not initialize anything itself."""
+    try:
+        return bool(jax.distributed.is_initialized())
+    except Exception:
+        return False
 
 
 def partition_files(files, num_processes, process_id):

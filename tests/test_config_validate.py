@@ -1,0 +1,147 @@
+"""Config schema validation: malformed .dragnetrc documents must load
+as DNError with the reference's error shape — 'failed to load config:
+property "<path>": <json-schema reason>' (lib/config-common.js:27-108
+via jsprim.validateJsonObject) — never a traceback."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import config as mod_config          # noqa: E402
+from dragnet_tpu.errors import DNError                # noqa: E402
+
+
+def _base(**over):
+    doc = {'vmaj': 0, 'vmin': 0, 'datasources': [], 'metrics': []}
+    doc.update(over)
+    return doc
+
+
+def _ds(**over):
+    d = {'name': 'd1', 'backend': 'file',
+         'backend_config': {'path': '/tmp/x'}, 'filter': None}
+    d.update(over)
+    return d
+
+
+def _met(**over):
+    m = {'name': 'm1', 'datasource': 'd1', 'filter': None,
+         'breakdowns': [{'name': 'host', 'field': 'host'}]}
+    m.update(over)
+    return m
+
+
+def _err(doc):
+    rv = mod_config.load_config(doc)
+    assert isinstance(rv, DNError), rv
+    return str(rv)
+
+
+def test_valid_roundtrip():
+    dc = mod_config.load_config(_base(
+        datasources=[_ds()], metrics=[_met()]))
+    assert not isinstance(dc, DNError)
+    assert dc.datasource_get('d1')['ds_backend'] == 'file'
+    assert dc.metric_get('d1', 'm1') is not None
+    # serialize -> load is stable
+    dc2 = mod_config.load_config(dc.serialize())
+    assert not isinstance(dc2, DNError)
+    assert dc2.serialize() == dc.serialize()
+
+
+def test_major_version_gate():
+    assert _err(_base(vmaj=1)) == \
+        'failed to load config: major version ("1") not supported'
+    assert _err({'vmin': 0}) == \
+        'failed to load config: major version ("undefined") not ' \
+        'supported'
+
+
+def test_vmin_must_be_number():
+    assert _err(_base(vmin='x')) == \
+        'failed to load config: property "vmin": string value found, ' \
+        'but a number is required'
+
+
+def test_toplevel_required():
+    doc = _base()
+    del doc['datasources']
+    assert _err(doc) == \
+        'failed to load config: property "datasources": is missing ' \
+        'and it is required'
+    doc = _base()
+    del doc['metrics']
+    assert _err(doc) == \
+        'failed to load config: property "metrics": is missing and ' \
+        'it is required'
+
+
+def test_toplevel_types():
+    assert _err(_base(datasources={})) == \
+        'failed to load config: property "datasources": object value ' \
+        'found, but a array is required'
+    assert _err(_base(metrics='nope')) == \
+        'failed to load config: property "metrics": string value ' \
+        'found, but a array is required'
+
+
+def test_datasource_entry_shape():
+    ds = _ds()
+    del ds['name']
+    assert _err(_base(datasources=[ds])) == \
+        'failed to load config: property "datasources[0].name": is ' \
+        'missing and it is required'
+    ds = _ds(backend=7)
+    assert _err(_base(datasources=[_ds(), ds])) == \
+        'failed to load config: property "datasources[1].backend": ' \
+        'number value found, but a string is required'
+    ds = _ds()
+    del ds['backend_config']
+    assert _err(_base(datasources=[ds])) == \
+        'failed to load config: property ' \
+        '"datasources[0].backend_config": is missing and it is required'
+    assert _err(_base(datasources=['x'])) == \
+        'failed to load config: property "datasources[0]": string ' \
+        'value found, but a object is required'
+    # null filter is valid (typeof null === 'object'); missing is not
+    dc = mod_config.load_config(_base(datasources=[_ds(filter=None)]))
+    assert not isinstance(dc, DNError)
+    ds = _ds()
+    del ds['filter']
+    assert 'property "datasources[0].filter": is missing' \
+        in _err(_base(datasources=[ds]))
+
+
+def test_metric_entry_shape():
+    m = _met()
+    del m['datasource']
+    assert _err(_base(metrics=[m])) == \
+        'failed to load config: property "metrics[0].datasource": is ' \
+        'missing and it is required'
+    m = _met(breakdowns='x')
+    assert _err(_base(metrics=[m])) == \
+        'failed to load config: property "metrics[0].breakdowns": ' \
+        'string value found, but a array is required'
+    m = _met(breakdowns=[{'name': 'host'}])
+    assert _err(_base(metrics=[m])) == \
+        'failed to load config: property ' \
+        '"metrics[0].breakdowns[0].field": is missing and it is ' \
+        'required'
+    m = _met(breakdowns=[{'name': 'l', 'field': 'l', 'step': 'x'}])
+    assert _err(_base(metrics=[m])) == \
+        'failed to load config: property ' \
+        '"metrics[0].breakdowns[0].step": string value found, but a ' \
+        'number is required'
+
+
+def test_backend_load_returns_fresh_config_on_error(tmp_path):
+    p = tmp_path / 'rc'
+    p.write_text('{"vmaj": 0, "vmin": 0, "datasources": [{}], '
+                 '"metrics": []}')
+    backend = mod_config.ConfigBackendLocal(str(p))
+    err, cfg = backend.load()
+    assert isinstance(err, DNError)
+    assert 'failed to load config' in str(err)
+    assert cfg.datasource_list() == []      # fresh initial config

@@ -1,0 +1,75 @@
+"""Structured logging (the reference's bunyan role, bin/dn:68-71):
+LOG_LEVEL-gated JSON lines with component child loggers."""
+
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import log as mod_log                # noqa: E402
+
+
+def test_level_gating_and_shape():
+    buf = io.StringIO()
+    lg = mod_log.Logger('dn', level=mod_log.INFO, stream=buf)
+    lg.debug('hidden', a=1)
+    lg.info('shown', nfiles=3)
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec['msg'] == 'shown'
+    assert rec['level'] == mod_log.INFO
+    assert rec['nfiles'] == 3
+    assert rec['name'] == 'dn'
+    assert 'time' in rec and 'pid' in rec and 'hostname' in rec
+
+
+def test_child_component():
+    buf = io.StringIO()
+    lg = mod_log.Logger('dn', level=mod_log.DEBUG, stream=buf)
+    child = lg.child('datasource-file', ds='x')
+    child.debug('scan start', nfiles=2)
+    rec = json.loads(buf.getvalue())
+    assert rec['component'] == 'datasource-file'
+    assert rec['ds'] == 'x'
+    assert rec['nfiles'] == 2
+
+
+def test_env_level(monkeypatch):
+    monkeypatch.setenv('LOG_LEVEL', 'debug')
+    assert mod_log.Logger('x').level == mod_log.DEBUG
+    monkeypatch.setenv('LOG_LEVEL', '50')
+    assert mod_log.Logger('x').level == 50
+    monkeypatch.setenv('LOG_LEVEL', 'bogus')
+    assert mod_log.Logger('x').level == mod_log.WARN
+    monkeypatch.delenv('LOG_LEVEL')
+    assert mod_log.Logger('x').level == mod_log.WARN
+
+
+def test_cli_scan_logs_under_log_level(tmp_path):
+    """End-to-end: LOG_LEVEL=debug surfaces the scan lifecycle."""
+    import subprocess
+    data = tmp_path / 'a.log'
+    data.write_text('{"host":"a"}\n{"host":"b"}\n')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, LOG_LEVEL='debug',
+               DRAGNET_CONFIG=str(tmp_path / 'rc'),
+               JAX_PLATFORMS='cpu')
+    subprocess.run([sys.executable, os.path.join(root, 'bin', 'dn.py'),
+                    'datasource-add', 'd', '--path=%s' % data],
+                   check=True, env=env, capture_output=True)
+    p = subprocess.run([sys.executable,
+                        os.path.join(root, 'bin', 'dn.py'),
+                        'scan', '-b', 'host', 'd'],
+                       check=True, env=env, capture_output=True)
+    recs = [json.loads(ln) for ln in p.stderr.decode().splitlines()
+            if ln.startswith('{')]
+    msgs = [r['msg'] for r in recs]
+    assert 'scan start' in msgs
+    assert 'scan done' in msgs
+    started = [r for r in recs if r['msg'] == 'scan start'][0]
+    assert started['component'] == 'datasource-file'
+    assert started['nfiles'] == 1

@@ -208,6 +208,16 @@ def test_cluster_dry_run_plan(tmp_path, capsys):
         'ds_filter': None, 'ds_format': 'json',
     })
     q = mod_query.query_load({'breakdowns': [{'name': 'host'}]})
+
+    # never probed: the plan reports the platform hint, not devices
+    # (a dry run must not pay backend initialization)
+    from dragnet_tpu import ops
+    if ops.backend_probed() is None:
+        r0 = ds.scan(mod_query.query_load(
+            {'breakdowns': [{'name': 'host'}]}), dry_run=True)
+        assert 'platform_hint' in r0.dry_run_plan['mesh']
+
+    ops.backend_ready()     # now devices are listable
     r = ds.scan(q, dry_run=True)
     plan = r.dry_run_plan
     assert plan['backend'] == 'cluster'
