@@ -1,0 +1,28 @@
+# dragnet-tpu build/test entry points (the reference's Makefile wired
+# `make` = deps, `make test` = catest -a, `make check` = lint;
+# Makefile:13-34).
+
+PYTHON ?= python3
+
+.PHONY: all native test check bench clean
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+check:
+	$(PYTHON) -m compileall -q dragnet_tpu bin/dn.py bench.py \
+	    __graft_entry__.py tests
+	$(PYTHON) tools/checkstyle dragnet_tpu bin tools/checkstyle \
+	    bench.py __graft_entry__.py
+
+bench: native
+	$(PYTHON) bench.py
+
+clean:
+	rm -rf native/build
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

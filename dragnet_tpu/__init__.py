@@ -14,9 +14,9 @@ datasource_for_config, datasource_for_name.
 """
 
 from .errors import DNError
-from .query import query_load, metric_serialize, metric_deserialize
-from . import query as mod_query
-from . import jsvalues as jsv
+from .query import query_load, metric_serialize, metric_deserialize  # noqa: F401 (facade)
+from . import query as mod_query      # noqa: F401 (facade)
+from . import jsvalues as jsv         # noqa: F401 (facade)
 from . import datasource_file
 
 __version__ = '0.1.0'

@@ -9,7 +9,6 @@ Exit codes: 2 for usage errors (with the usage text on stderr), 1 for
 fatal runtime errors ("dn: <message>").
 """
 
-import os
 import sys
 
 from .errors import DNError

@@ -43,6 +43,7 @@ import numpy as np
 from . import jsvalues as jsv
 from . import log as mod_log
 from . import query as mod_query
+from . import watchdog
 from .engine import (VectorScan, NativeColumns, MAX_DENSE_SEGMENTS,
                      BATCH_SIZE, engine_mode)
 from .ops.kernels import FALSE, TRUE, ERROR
@@ -62,6 +63,13 @@ I64MAX = 2 ** 63 - 1
 SYNC_EVERY_BATCHES = 32
 
 LOG = mod_log.get('device-scan')
+
+
+# a DeviceScan dropped with batches still folded in its device
+# accumulator means those results never merged
+_SCAN_LEAKS = watchdog.LeakCheck(
+    'device scan(s) with unflushed accumulators; results may be '
+    'incomplete', lambda s: s._acc is not None)
 
 
 def _rate_field(r):
@@ -211,6 +219,7 @@ class DeviceScan(VectorScan):
     def __init__(self, query, time_field, pipeline, ds_filter=None):
         VectorScan.__init__(self, query, time_field, pipeline,
                             ds_filter=ds_filter)
+        _SCAN_LEAKS.track(self)
         self._records_seen = 0
         self._backend_ok = None
         self._host_records = 0
@@ -1117,6 +1126,9 @@ class _ShadowProbe(object):
             scans = self.make_scans()
             for s in scans:
                 s._backend_ok = True
+                # scratch scans: their results are discarded by design,
+                # so an unflushed accumulator here is not lost work
+                _SCAN_LEAKS.untrack(s)
 
             def run_one(snap, n):
                 provider = self.make_provider(snap)

@@ -113,7 +113,8 @@ def number_to_string(v):
             # always.  Convert.
             mant, exp = ('%e' % v).split('e')
             mant = mant.rstrip('0').rstrip('.')
-            s = mant + 'e' + ('-' if int(exp) < 0 else '+') + str(abs(int(exp)))
+            s = mant + 'e' + ('-' if int(exp) < 0 else '+') + \
+                str(abs(int(exp)))
     return s
 
 

@@ -14,7 +14,6 @@ import threading
 
 import numpy as np
 
-from . import jsvalues as jsv
 
 TAG_MISSING = 0
 TAG_NULL = 1

@@ -29,6 +29,14 @@ import os
 import queue
 import threading
 
+from .watchdog import LeakCheck
+
+# an executor that is never finish()ed means submitted batches may
+# never have merged into the result
+_EXECUTOR_LEAKS = LeakCheck(
+    'scan executor(s) never drained; results may be incomplete',
+    lambda ex: not ex.closed)
+
 
 def scan_threads():
     v = os.environ.get('DN_SCAN_THREADS', 'auto')
@@ -152,6 +160,8 @@ class MTScanExecutor(object):
     def __init__(self, nworkers, build_worker, apply_result,
                  main_pipeline, stage_offset):
         from .vpipe import Pipeline
+        self.closed = False
+        _EXECUTOR_LEAKS.track(self)
         self.nworkers = nworkers
         self.apply_result = apply_result
         self.main_pipeline = main_pipeline
@@ -219,6 +229,7 @@ class MTScanExecutor(object):
         self.seq += 1
 
     def close(self):
+        self.closed = True
         for _ in self.threads:
             self.workq.put(None)
         for t in self.threads:

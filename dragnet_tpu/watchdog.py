@@ -1,0 +1,41 @@
+"""Lost-work detection at interpreter exit — the role of the
+reference's premature-exit watchdog (bin/dn:1276-1311, which caught
+lost-callback bugs in the event loop): resources that still hold
+un-merged work when the process exits mean the printed result may be
+incomplete, and that must be loud."""
+
+import atexit
+import sys
+import weakref
+
+
+class LeakCheck(object):
+    """Weakly tracks live resources; at interpreter exit, any tracked
+    object for which `predicate` is true counts as leaked work and
+    produces a premature-exit error on stderr."""
+
+    def __init__(self, message, predicate):
+        self.items = weakref.WeakSet()
+        self.message = message
+        self.predicate = predicate
+        self._registered = False
+
+    def track(self, obj):
+        self.items.add(obj)
+        if not self._registered:
+            self._registered = True
+            atexit.register(self._check)
+
+    def untrack(self, obj):
+        self.items.discard(obj)
+
+    def _check(self):
+        try:
+            leaked = sum(1 for o in list(self.items)
+                         if self.predicate(o))
+        except Exception:
+            return
+        if leaked:
+            sys.stderr.write(
+                'ERROR: internal error: premature exit (%d %s)\n'
+                % (leaked, self.message))
