@@ -737,34 +737,29 @@ class DatasourceFile(object):
             total += sz if sz and sz > 0 else 0
         done = 0
         carry = b''
-        for path, st in files:
-            with open(path, 'rb') as f:
-                while True:
-                    chunk = f.read(readsz)
-                    if not chunk:
-                        break
-                    done += len(chunk)
-                    nl = chunk.rfind(b'\n')
-                    if nl == -1:
-                        carry += chunk
-                        continue
-                    if parse_at is None:
-                        parser.parse(carry + chunk[:nl + 1])
-                    else:
-                        start = 0
-                        if carry:
-                            first = chunk.index(b'\n', 0, nl + 1)
-                            parser.parse(carry + chunk[:first + 1])
-                            start = first + 1
-                        arr = np.frombuffer(chunk, dtype=np.uint8)
-                        if nl + 1 > start:
-                            parse_at(arr[start:].ctypes.data,
-                                     nl + 1 - start)
-                    carry = chunk[nl + 1:]
-                    if parser.batch_size() >= batch_size:
-                        if progress is not None:
-                            progress(done, total)
-                        flush()
+        for chunk in _read_ahead(files, readsz):
+            done += len(chunk)
+            nl = chunk.rfind(b'\n')
+            if nl == -1:
+                carry += chunk
+                continue
+            if parse_at is None:
+                parser.parse(carry + chunk[:nl + 1])
+            else:
+                start = 0
+                if carry:
+                    first = chunk.index(b'\n', 0, nl + 1)
+                    parser.parse(carry + chunk[:first + 1])
+                    start = first + 1
+                arr = np.frombuffer(chunk, dtype=np.uint8)
+                if nl + 1 > start:
+                    parse_at(arr[start:].ctypes.data,
+                             nl + 1 - start)
+            carry = chunk[nl + 1:]
+            if parser.batch_size() >= batch_size:
+                if progress is not None:
+                    progress(done, total)
+                flush()
         if carry:
             parser.parse(carry)
         if progress is not None:
@@ -900,6 +895,55 @@ class DatasourceFile(object):
         return ScanResult(pipeline, points=aggr.points(), query=query)
 
 
+def _read_ahead(files, readsz):
+    """Yield the concatenated chunk stream of `files` with a producer
+    thread reading one chunk ahead (so file IO overlaps parse and
+    engine work while at most ~2 chunks are resident).  Producer
+    exceptions (unreadable file mid-stream) re-raise at the
+    consumer."""
+    import queue as mod_queue
+    import threading
+
+    q = mod_queue.Queue(maxsize=1)
+    stop = threading.Event()
+
+    def put(item):
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except mod_queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for path, st in files:
+                with open(path, 'rb') as f:
+                    while True:
+                        chunk = f.read(readsz)
+                        if not chunk:
+                            break
+                        if not put(chunk):
+                            return
+            put(None)
+        except BaseException as e:     # re-raised by the consumer
+            put(e)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 def _bump_parse_counters(parser_stage, adapter_stage, nlines, nbad, n):
     """Parse-layer counters (totals are monotonic; assigned, not
     accumulated) plus the per-batch adapter bumps."""
@@ -965,6 +1009,25 @@ class _RemappedParser(object):
 
     def dictionary(self, path):
         return self.parser.dictionary(self.remap[path])
+
+    # one-pass batch stats (device-path eligibility); absent on
+    # snapshot sources — callers feature-test with getattr
+    def field_stats(self, path):
+        fn = getattr(self.parser, 'field_stats', None)
+        return None if fn is None else fn(self.remap[path])
+
+    def nums_i32(self, path):
+        return self.parser.nums_i32(self.remap[path])
+
+    def date_stats(self, path):
+        fn = getattr(self.parser, 'date_stats', None)
+        return None if fn is None else fn(self.remap[path])
+
+    def date_i32(self, path):
+        return self.parser.date_i32(self.remap[path])
+
+    def date_err(self, path):
+        return self.parser.date_err(self.remap[path])
 
 
 def _split_lines(instream):

@@ -475,20 +475,39 @@ class DeviceScan(VectorScan):
             else np.asarray(alive, dtype=bool)
         inputs['weights'] = w.astype(np.int32)
 
+        # one-pass native batch statistics make the eligibility checks
+        # O(1) numpy work per field (snapshot providers — the shadow
+        # audition, MT workers — lack them and take the numpy path)
+        src = provider.parser
+
+        def _stats(f):
+            fn = getattr(src, 'field_stats', None)
+            return fn(f) if fn is not None else None
+
         # filter fields: tags + string codes + exact-i32 numeric values
         for f in self.filter_fields:
-            tags, nums, strcodes = provider._field(f)
-            if (tags == mn.TAG_ARRAY).any():
-                return False
-            m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
-            iv = np.zeros(n, dtype=np.int32)
-            if m.any():
-                nm = nums[m]
-                if not (np.all(np.isfinite(nm)) and
-                        np.all(nm == np.floor(nm)) and
-                        nm.min() >= I32MIN and nm.max() <= I32MAX):
+            st = _stats(f)
+            if st is not None:
+                narr, i32ok, _, _, nnum, _ = st
+                if narr:
                     return False
-                iv[m] = nm.astype(np.int64).astype(np.int32)
+                if nnum and not i32ok:
+                    return False
+                tags, _, strcodes = provider._field(f)
+                iv = src.nums_i32(f)
+            else:
+                tags, nums, strcodes = provider._field(f)
+                if (tags == mn.TAG_ARRAY).any():
+                    return False
+                m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
+                iv = np.zeros(n, dtype=np.int32)
+                if m.any():
+                    nm = nums[m]
+                    if not (np.all(np.isfinite(nm)) and
+                            np.all(nm == np.floor(nm)) and
+                            nm.min() >= I32MIN and nm.max() <= I32MAX):
+                        return False
+                    iv[m] = nm.astype(np.int64).astype(np.int32)
             inputs['tags_' + f] = tags.astype(np.uint8, copy=False)
             inputs['str_' + f] = strcodes.astype(np.int32, copy=False)
             inputs['num_' + f] = iv
@@ -496,12 +515,27 @@ class DeviceScan(VectorScan):
         # synthetic date fields: combined first-error + needed ts columns
         synth_vals = {}
         if self.synthetic:
+            dstats_fn = getattr(src, 'date_stats', None)
+            first_ds = dstats_fn(self.synthetic[0]['field']) \
+                if dstats_fn is not None else None
+            use_dstats = first_ds is not None
             errs = None
-            for fc in self.synthetic:
-                vals, err = provider.date_column(fc['field'])
-                synth_vals[fc['name']] = vals
-                errs = err if errs is None else \
-                    np.where(errs == 0, err, errs)
+            if use_dstats:
+                for i, fc in enumerate(self.synthetic):
+                    all_i32, nok = first_ds if i == 0 \
+                        else dstats_fn(fc['field'])
+                    if nok and not all_i32:
+                        return False
+                    err = src.date_err(fc['field'])
+                    synth_vals[fc['name']] = src.date_i32(fc['field'])
+                    errs = err if errs is None else \
+                        np.where(errs == 0, err, errs)
+            else:
+                for fc in self.synthetic:
+                    vals, err = provider.date_column(fc['field'])
+                    synth_vals[fc['name']] = vals
+                    errs = err if errs is None else \
+                        np.where(errs == 0, err, errs)
             ok = errs == 0
             need = set()
             if self.time_bounds is not None:
@@ -511,6 +545,10 @@ class DeviceScan(VectorScan):
                     need.add(p.field[len('\0synth:'):])
             for name in need:
                 v = synth_vals[name]
+                if use_dstats:
+                    # already exact-i32 with error rows zeroed
+                    inputs['ts_' + name] = v
+                    continue
                 vo = v[ok]
                 if len(vo) and not (np.all(np.isfinite(vo)) and
                                     np.all(vo == np.floor(vo)) and
@@ -526,8 +564,12 @@ class DeviceScan(VectorScan):
         pending = []  # deferred plan-state commits
         for p in self._plans:
             if p.kind == 'str':
+                st = _stats(p.name)
                 tags, nums, strcodes = provider._field(p.name)
-                all_str = bool((tags == mn.TAG_STRING).all())
+                if st is not None:
+                    all_str = st[5] == n
+                else:
+                    all_str = bool((tags == mn.TAG_STRING).all())
                 host = p.host_translate or not all_str
                 if host:
                     codes = np.asarray(
@@ -563,26 +605,43 @@ class DeviceScan(VectorScan):
                     # zero-filled error rows are dead and must not
                     # anchor the window at ordinal 0
                     sel = synth_vals[sname][ok]
+                    minmax = (int(sel.min()), int(sel.max())) \
+                        if len(sel) else None
                 else:
-                    vals, valid = provider.numeric_column(p.name)
-                    vv = vals[valid]
-                    if len(vv) and not (np.all(np.isfinite(vv)) and
-                                        np.all(vv == np.floor(vv)) and
-                                        vv.min() >= I32MIN and
-                                        vv.max() <= I32MAX):
-                        return False
-                    fill = int(vv[0]) if len(vv) else 0
-                    v = np.where(valid, vals, fill).astype(np.int64)
-                    inputs['kv_' + p.name] = v.astype(np.int32)
-                    inputs['kvalid_' + p.name] = valid
-                    sel = vv
+                    st = _stats(p.name)
+                    if st is not None and st[0] == 0 and st[5] == 0:
+                        # no strings/arrays: the numeric rows ARE the
+                        # valid rows, and min/max come from the stats
+                        narr, i32ok, nmn, nmx, nnum, _ = st
+                        if nnum and not i32ok:
+                            return False
+                        tags_k = provider._field(p.name)[0]
+                        inputs['kv_' + p.name] = src.nums_i32(p.name)
+                        inputs['kvalid_' + p.name] = \
+                            (tags_k == mn.TAG_INT) | \
+                            (tags_k == mn.TAG_NUMBER)
+                        minmax = (int(nmn), int(nmx)) if nnum else None
+                    else:
+                        vals, valid = provider.numeric_column(p.name)
+                        vv = vals[valid]
+                        if len(vv) and not (np.all(np.isfinite(vv)) and
+                                            np.all(vv == np.floor(vv))
+                                            and vv.min() >= I32MIN and
+                                            vv.max() <= I32MAX):
+                            return False
+                        fill = int(vv[0]) if len(vv) else 0
+                        v = np.where(valid, vals, fill).astype(np.int64)
+                        inputs['kv_' + p.name] = v.astype(np.int32)
+                        inputs['kvalid_' + p.name] = valid
+                        minmax = (int(vv.min()), int(vv.max())) \
+                            if len(vv) else None
                 if p.kind == 'p2':
                     new_caps.append(p.cap)  # fixed [0, 32)
                     pending.append((p, p.cap, 0, False, True))
                     continue
-                if len(sel):
-                    omin = int(np.floor_divide(int(sel.min()), p.step))
-                    omax = int(np.floor_divide(int(sel.max()), p.step))
+                if minmax is not None:
+                    omin = int(np.floor_divide(minmax[0], p.step))
+                    omax = int(np.floor_divide(minmax[1], p.step))
                     if p.window_set:
                         lo = min(p.lo, omin)
                         hi = max(p.lo + p.cap - 1, omax)
@@ -590,10 +649,12 @@ class DeviceScan(VectorScan):
                         lo, hi = omin, omax
                     cap = max(p.cap, _pow2(hi - lo + 1))
                     wset = True
+                    new_caps.append(cap)
+                    pending.append((p, cap, lo, False, wset))
                 else:
-                    lo, cap, wset = p.lo, p.cap, p.window_set
-                new_caps.append(cap)
-                pending.append((p, cap, lo, False, wset))
+                    new_caps.append(p.cap)
+                    pending.append((p, p.cap, p.lo, False,
+                                    p.window_set))
 
         ns = 1
         for c in new_caps:

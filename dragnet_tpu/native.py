@@ -134,6 +134,18 @@ def get_lib():
         lib.dn_parser_dateerr.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.dn_parser_dateerr.argtypes = [ctypes.c_void_p,
                                           ctypes.c_int32]
+        for name in ('dn_parser_field_stats', 'dn_parser_date_stats'):
+            fn = getattr(lib, name, None)
+            if fn is not None:
+                fn.restype = None
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                               ctypes.POINTER(ctypes.c_double)]
+        for name in ('dn_parser_nums_i32', 'dn_parser_date_i32'):
+            fn = getattr(lib, name, None)
+            if fn is not None:
+                fn.restype = None
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                               ctypes.POINTER(ctypes.c_int32)]
         lib.dn_parser_dict_size.restype = ctypes.c_int32
         lib.dn_parser_dict_size.argtypes = [ctypes.c_void_p,
                                             ctypes.c_int32]
@@ -259,3 +271,51 @@ class NativeParser(object):
 
     def reset_batch(self):
         self.lib.dn_parser_reset_batch(self.h)
+
+    # -- one-pass batch statistics (device-path eligibility) -----------
+
+    def field_stats(self, field):
+        """(n_array, all_nums_i32, num_min, num_max, n_num, n_str) of
+        the current batch, in one native pass."""
+        if not hasattr(self.lib, 'dn_parser_field_stats'):
+            return None
+        out = (ctypes.c_double * 6)()
+        self.lib.dn_parser_field_stats(self.h, self.field_index[field],
+                                       out)
+        return (int(out[0]), bool(out[1]), out[2], out[3],
+                int(out[4]), int(out[5]))
+
+    def nums_i32(self, field):
+        """Numeric rows cast to i32 (others 0); only valid after
+        field_stats reported all_nums_i32."""
+        n = self.batch_size()
+        arr = np.zeros(n, dtype=np.int32)
+        if n:
+            self.lib.dn_parser_nums_i32(
+                self.h, self.field_index[field],
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return arr
+
+    def date_stats(self, field):
+        """(all_ok_rows_i32, n_ok) over error-free date rows."""
+        if not hasattr(self.lib, 'dn_parser_date_stats'):
+            return None
+        out = (ctypes.c_double * 2)()
+        self.lib.dn_parser_date_stats(self.h, self.field_index[field],
+                                      out)
+        return (bool(out[0]), int(out[1]))
+
+    def date_i32(self, field):
+        """Epoch seconds as i32 (error rows 0)."""
+        n = self.batch_size()
+        arr = np.zeros(n, dtype=np.int32)
+        if n:
+            self.lib.dn_parser_date_i32(
+                self.h, self.field_index[field],
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return arr
+
+    def date_err(self, field):
+        """The date-error column alone (no epoch-seconds copy)."""
+        return self._np(self.lib.dn_parser_dateerr, field, np.uint8,
+                        self.batch_size())

@@ -1056,6 +1056,99 @@ const uint8_t* dn_parser_dateerr(void* h, int32_t field) {
   return static_cast<Parser*>(h)->fields[field].dateerr.data();
 }
 
+// One-pass per-field batch statistics for the device path's
+// eligibility checks (replacing several numpy scans per batch):
+//   out[0] = count of TAG_ARRAY rows
+//   out[1] = 1 when every numeric row is a finite integer within int32
+//   out[2] = numeric min (0 when no numeric rows)
+//   out[3] = numeric max (0 when no numeric rows)
+//   out[4] = count of numeric rows (TAG_INT | TAG_NUMBER)
+//   out[5] = count of TAG_STRING rows
+void dn_parser_field_stats(void* h, int32_t field, double* out) {
+  Parser* pr = static_cast<Parser*>(h);
+  FieldOut& f = pr->fields[field];
+  size_t n = f.tags.size();
+  int64_t narr = 0, nnum = 0, nstr = 0;
+  int all_i32 = 1;
+  double mn = 0.0, mx = 0.0;
+  for (size_t i = 0; i < n; i++) {
+    uint8_t t = f.tags[i];
+    if (t == TAG_INT || t == TAG_NUMBER) {
+      double v = f.nums[i];
+      if (nnum == 0) {
+        mn = mx = v;
+      } else {
+        if (v < mn) mn = v;
+        if (v > mx) mx = v;
+      }
+      nnum++;
+      // NaN/inf fail the comparisons, clearing the flag
+      if (!(v >= -2147483648.0 && v <= 2147483647.0 &&
+            v == std::floor(v))) {
+        all_i32 = 0;
+      }
+    } else if (t == TAG_ARRAY) {
+      narr++;
+    } else if (t == TAG_STRING) {
+      nstr++;
+    }
+  }
+  out[0] = static_cast<double>(narr);
+  out[1] = static_cast<double>(all_i32);
+  out[2] = mn;
+  out[3] = mx;
+  out[4] = static_cast<double>(nnum);
+  out[5] = static_cast<double>(nstr);
+}
+
+// Numeric rows cast to int32 (caller must have checked the all-i32
+// stat); non-numeric rows are 0.
+void dn_parser_nums_i32(void* h, int32_t field, int32_t* out) {
+  Parser* pr = static_cast<Parser*>(h);
+  FieldOut& f = pr->fields[field];
+  size_t n = f.tags.size();
+  for (size_t i = 0; i < n; i++) {
+    uint8_t t = f.tags[i];
+    out[i] = (t == TAG_INT || t == TAG_NUMBER)
+                 ? static_cast<int32_t>(f.nums[i])
+                 : 0;
+  }
+}
+
+// Date-column stats over error-free rows:
+//   out[0] = 1 when every ok row's epoch-seconds is an integer in i32
+//   out[1] = count of ok rows
+void dn_parser_date_stats(void* h, int32_t field, double* out) {
+  Parser* pr = static_cast<Parser*>(h);
+  FieldOut& f = pr->fields[field];
+  size_t n = f.dateerr.size();
+  int all_i32 = 1;
+  int64_t nok = 0;
+  for (size_t i = 0; i < n; i++) {
+    if (f.dateerr[i] != 0) continue;
+    nok++;
+    double v = f.datesecs[i];
+    if (!(v >= -2147483648.0 && v <= 2147483647.0 &&
+          v == std::floor(v))) {
+      all_i32 = 0;
+    }
+  }
+  out[0] = static_cast<double>(all_i32);
+  out[1] = static_cast<double>(nok);
+}
+
+// Epoch seconds as int32 (error rows 0); caller checks date_stats.
+void dn_parser_date_i32(void* h, int32_t field, int32_t* out) {
+  Parser* pr = static_cast<Parser*>(h);
+  FieldOut& f = pr->fields[field];
+  size_t n = f.dateerr.size();
+  for (size_t i = 0; i < n; i++) {
+    out[i] = (f.dateerr[i] == 0)
+                 ? static_cast<int32_t>(f.datesecs[i])
+                 : 0;
+  }
+}
+
 int32_t dn_parser_dict_size(void* h, int32_t field) {
   return static_cast<int32_t>(
       static_cast<Parser*>(h)->fields[field].dict.values.size());
