@@ -1029,6 +1029,12 @@ class _RemappedParser(object):
     def date_err(self, path):
         return self.parser.date_err(self.remap[path])
 
+    def tags_col(self, path):
+        return self.parser.tags_col(self.remap[path])
+
+    def strcodes_col(self, path):
+        return self.parser.strcodes_col(self.remap[path])
+
 
 def _split_lines(instream):
     data = instream.read()

@@ -233,6 +233,7 @@ class DeviceScan(VectorScan):
         self._progress = None     # (bytes_done, bytes_total) from stream
         self._shadow_ctx = None   # set by enable_shadow (MT path)
         self._shadow = None
+        self._sticky = None       # upload-profile state (see _try_device)
         self._plans = None            # built lazily from the query
         self._epoch_sig = None
         self._programs = None
@@ -471,9 +472,28 @@ class DeviceScan(VectorScan):
             return False
 
         inputs = {}
-        inputs['alive'] = np.ones(n, dtype=bool) if alive is None \
-            else np.asarray(alive, dtype=bool)
-        inputs['weights'] = w.astype(np.int32)
+        # Upload profile: static per-program flags that let the body
+        # synthesize constant inputs on device instead of uploading
+        # them — the H2D bytes per record are the device path's cost
+        # floor on bandwidth-limited transports (tunneled plugins).
+        # Flags are STICKY toward the most general variant (an
+        # observation can only widen them), so a scan recompiles at
+        # most once per flag even when the data is heterogeneous —
+        # a per-batch profile would retrace inside the probation /
+        # audition timing windows and make the device look slow.
+        sk = self._sticky
+        if sk is None:
+            sk = self._sticky = {'w1': True, 'gen_alive': True,
+                                 'filter': {}, 'kvalid': {}}
+        sk['w1'] = w1 = sk['w1'] and bool(np.all(w == 1.0))
+        sk['gen_alive'] = gen_alive = sk['gen_alive'] and alive is None
+        if gen_alive:
+            inputs['nvalid'] = np.int32(n)
+        else:
+            inputs['alive'] = np.ones(n, dtype=bool) if alive is None \
+                else np.asarray(alive, dtype=bool)
+        if not w1:
+            inputs['weights'] = w.astype(np.int32)
 
         # one-pass native batch statistics make the eligibility checks
         # O(1) numpy work per field (snapshot providers — the shadow
@@ -484,33 +504,63 @@ class DeviceScan(VectorScan):
             fn = getattr(src, 'field_stats', None)
             return fn(f) if fn is not None else None
 
-        # filter fields: tags + string codes + exact-i32 numeric values
+        def _widen(table, key, has_str, has_num, all_num):
+            cur = table.get(key)
+            if cur is None:
+                cur = table[key] = [has_str, has_num, all_num]
+            else:
+                cur[0] = cur[0] or has_str
+                cur[1] = cur[1] or has_num
+                cur[2] = cur[2] and all_num
+            return cur
+
+        # filter fields: tags + string codes + exact-i32 numeric
+        # values, each uploaded only when this scan has seen rows of
+        # that kind in the field
+        filter_profile = []
         for f in self.filter_fields:
             st = _stats(f)
             if st is not None:
-                narr, i32ok, _, _, nnum, _ = st
+                narr, i32ok, _, _, nnum, nstr = st
                 if narr:
                     return False
                 if nnum and not i32ok:
                     return False
-                tags, _, strcodes = provider._field(f)
-                iv = src.nums_i32(f)
+                has_str, has_num, all_num = _widen(
+                    sk['filter'], f, nstr > 0, nnum > 0, nnum == n)
+                tags = src.tags_col(f) if not all_num else None
+                strcodes = src.strcodes_col(f) if has_str else None
+                iv = src.nums_i32(f) if has_num else None
             else:
                 tags, nums, strcodes = provider._field(f)
                 if (tags == mn.TAG_ARRAY).any():
                     return False
                 m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
-                iv = np.zeros(n, dtype=np.int32)
-                if m.any():
+                obs_num = bool(m.any())
+                if obs_num:
                     nm = nums[m]
                     if not (np.all(np.isfinite(nm)) and
                             np.all(nm == np.floor(nm)) and
                             nm.min() >= I32MIN and nm.max() <= I32MAX):
                         return False
-                    iv[m] = nm.astype(np.int64).astype(np.int32)
-            inputs['tags_' + f] = tags.astype(np.uint8, copy=False)
-            inputs['str_' + f] = strcodes.astype(np.int32, copy=False)
-            inputs['num_' + f] = iv
+                has_str, has_num, all_num = _widen(
+                    sk['filter'], f, bool((tags == mn.TAG_STRING)
+                                          .any()), obs_num,
+                    bool(m.all()))
+                iv = None
+                if has_num:
+                    iv = np.zeros(n, dtype=np.int32)
+                    if obs_num:
+                        iv[m] = nums[m].astype(np.int64).astype(
+                            np.int32)
+            filter_profile.append((f, has_str, has_num, all_num))
+            if not all_num:
+                inputs['tags_' + f] = tags.astype(np.uint8, copy=False)
+            if has_str:
+                inputs['str_' + f] = strcodes.astype(np.int32,
+                                                     copy=False)
+            if has_num:
+                inputs['num_' + f] = iv
 
         # synthetic date fields: combined first-error + needed ts columns
         synth_vals = {}
@@ -562,13 +612,15 @@ class DeviceScan(VectorScan):
         # key columns: update windows/caps, assemble uploads
         new_caps = []
         pending = []  # deferred plan-state commits
+        kvalid_profile = []   # plan names whose kvalid upload is skipped
         for p in self._plans:
             if p.kind == 'str':
                 st = _stats(p.name)
-                tags, nums, strcodes = provider._field(p.name)
                 if st is not None:
                     all_str = st[5] == n
+                    strcodes = None    # fetched only if needed below
                 else:
+                    tags, _, strcodes = provider._field(p.name)
                     all_str = bool((tags == mn.TAG_STRING).all())
                 host = p.host_translate or not all_str
                 if host:
@@ -592,6 +644,8 @@ class DeviceScan(VectorScan):
                         self._trans_dev[p.name] = (len(trans), dev)
                     inputs['trans_' + p.name] = \
                         self._trans_dev[p.name][1]
+                    if strcodes is None:
+                        strcodes = src.strcodes_col(p.name)
                     inputs['str_' + p.name] = strcodes.astype(
                         np.int32, copy=False)
                 radix = len(p.column.dict.values)
@@ -615,11 +669,18 @@ class DeviceScan(VectorScan):
                         narr, i32ok, nmn, nmx, nnum, _ = st
                         if nnum and not i32ok:
                             return False
-                        tags_k = provider._field(p.name)[0]
                         inputs['kv_' + p.name] = src.nums_i32(p.name)
-                        inputs['kvalid_' + p.name] = \
-                            (tags_k == mn.TAG_INT) | \
-                            (tags_k == mn.TAG_NUMBER)
+                        kv_skip = sk['kvalid'].get(p.name, True) and \
+                            nnum == n
+                        sk['kvalid'][p.name] = kv_skip
+                        if kv_skip:
+                            # every row numeric: no validity upload
+                            kvalid_profile.append(p.name)
+                        else:
+                            tags_k = src.tags_col(p.name)
+                            inputs['kvalid_' + p.name] = \
+                                (tags_k == mn.TAG_INT) | \
+                                (tags_k == mn.TAG_NUMBER)
                         minmax = (int(nmn), int(nmx)) if nnum else None
                     else:
                         vals, valid = provider.numeric_column(p.name)
@@ -632,7 +693,13 @@ class DeviceScan(VectorScan):
                         fill = int(vv[0]) if len(vv) else 0
                         v = np.where(valid, vals, fill).astype(np.int64)
                         inputs['kv_' + p.name] = v.astype(np.int32)
-                        inputs['kvalid_' + p.name] = valid
+                        kv_skip = sk['kvalid'].get(p.name, True) and \
+                            bool(valid.all())
+                        sk['kvalid'][p.name] = kv_skip
+                        if kv_skip:
+                            kvalid_profile.append(p.name)
+                        else:
+                            inputs['kvalid_' + p.name] = valid
                         minmax = (int(vv.min()), int(vv.max())) \
                             if len(vv) else None
                 if p.kind == 'p2':
@@ -714,14 +781,18 @@ class DeviceScan(VectorScan):
                         len(v) == n:
                     inputs[k] = np.concatenate(
                         [v, np.zeros(pad, dtype=v.dtype)])
-            inputs['alive'][n:] = False
+            if not gen_alive:
+                inputs['alive'][n:] = False
 
-        progs = self._programs.get(pn) if self._programs else None
+        profile = (w1, gen_alive, tuple(filter_profile),
+                   tuple(kvalid_profile))
+        pkey = (pn, profile)
+        progs = self._programs.get(pkey) if self._programs else None
         if progs is None:
-            progs = self._build_programs(tuple(new_caps), pn)
+            progs = self._build_programs(tuple(new_caps), pn, profile)
             if self._programs is None:
                 self._programs = {}
-            self._programs[pn] = progs
+            self._programs[pkey] = progs
         run_scatter, run_pallas, acc_init = progs
         from .ops import pallas_kernels as pk
         use_pallas = run_pallas is not None and \
@@ -747,11 +818,14 @@ class DeviceScan(VectorScan):
 
     # -- the device program -------------------------------------------------
 
-    def _program_key(self, caps, n):
+    def _program_key(self, caps, n, profile):
         """Canonical static structure of the device program: two scans
         with equal keys trace to identical programs, so the jitted
         callables (and their XLA executables) are shared via
-        _PROGRAM_CACHE."""
+        _PROGRAM_CACHE.  `profile` is the batch's upload profile
+        (which inputs are synthesized on device instead of uploaded);
+        batches with different profiles use different cached
+        variants."""
         plans = tuple((p.kind, p.name, p.field, p.step, p.lo,
                        p.host_translate) for p in self._plans)
         leaves = tuple(
@@ -767,6 +841,7 @@ class DeviceScan(VectorScan):
             tuple(sorted(s['name'] for s in self.synthetic)),
             len(self._counter_spec),
             self._mesh_key(),
+            profile,
         )
 
     # -- mesh hooks (no-ops on the single-device path; the cluster
@@ -784,12 +859,12 @@ class DeviceScan(VectorScan):
         mesh, axis = m
         return (axis, tuple(d.id for d in mesh.devices.flat))
 
-    def _build_programs(self, caps, n):
-        key = self._program_key(caps, n)
+    def _build_programs(self, caps, n, profile):
+        key = self._program_key(caps, n, profile)
         cached = _PROGRAM_CACHE.get(key)
         if cached is not None:
             return cached
-        progs = self._trace_programs(caps, n)
+        progs = self._trace_programs(caps, n, profile)
         if len(_PROGRAM_CACHE) >= 64:
             # bounded: evict oldest (dict preserves insertion order);
             # re-tracing is cheap next to the XLA compile, which the
@@ -798,11 +873,16 @@ class DeviceScan(VectorScan):
         _PROGRAM_CACHE[key] = progs
         return progs
 
-    def _trace_programs(self, caps, n):
+    def _trace_programs(self, caps, n, profile):
         jax, jnp = get_jax()
         from . import native as mod_native
         mn = mod_native
         from .ops import pallas_kernels as pk
+
+        w1, gen_alive, filter_profile, kvalid_skip = profile
+        fprof = {f: (has_str, has_num, all_num)
+                 for f, has_str, has_num, all_num in filter_profile}
+        kvalid_skip = frozenset(kvalid_skip)
 
         # Freeze the per-plan statics NOW: the cached lambdas re-trace
         # whenever an input shape grows (e.g. a translate table crossing
@@ -847,32 +927,42 @@ class DeviceScan(VectorScan):
             nshards = 1
             bn = n
 
+        def leaf_num_out(i, args, f):
+            mode, t = num_plans[i]
+            if mode == NUM_FALSE:
+                return jnp.full((bn,), FALSE, dtype=jnp.int8)
+            if mode == NUM_TRUE:
+                return jnp.full((bn,), TRUE, dtype=jnp.int8)
+            v = args['num_' + f]
+            tt = i32(t)
+            if mode == NUM_EQ:
+                hit = v == tt
+            elif mode == NUM_NE:
+                hit = v != tt
+            elif mode == NUM_LE:
+                hit = v <= tt
+            else:
+                hit = v >= tt
+            return jnp.where(hit, jnp.int8(TRUE), jnp.int8(FALSE))
+
         def leaf_out(key, args):
             i = leaf_index[key]
             f = leaf_fields[i]
+            has_str, has_num, all_num = fprof.get(f,
+                                                  (True, True, False))
+            if all_num:
+                # every row numeric: tags/str uploads were skipped
+                return leaf_num_out(i, args, f)
             tags = args['tags_' + f]
             out = args['ctab_%d' % i][tags]
-            out = jnp.where(tags == mn.TAG_STRING,
-                            args['tab_%d' % i][args['str_' + f]], out)
-            mode, t = num_plans[i]
+            if has_str:
+                out = jnp.where(tags == mn.TAG_STRING,
+                                args['tab_%d' % i][args['str_' + f]],
+                                out)
+            if not has_num:
+                return out
             numm = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
-            v = args['num_' + f]
-            if mode == NUM_FALSE:
-                nout = jnp.full((bn,), FALSE, dtype=jnp.int8)
-            elif mode == NUM_TRUE:
-                nout = jnp.full((bn,), TRUE, dtype=jnp.int8)
-            else:
-                tt = i32(t)
-                if mode == NUM_EQ:
-                    hit = v == tt
-                elif mode == NUM_NE:
-                    hit = v != tt
-                elif mode == NUM_LE:
-                    hit = v <= tt
-                else:
-                    hit = v >= tt
-                nout = jnp.where(hit, jnp.int8(TRUE), jnp.int8(FALSE))
-            return jnp.where(numm, nout, out)
+            return jnp.where(numm, leaf_num_out(i, args, f), out)
 
         def eval_ast(ast, args):
             if not ast:
@@ -900,8 +990,19 @@ class DeviceScan(VectorScan):
             return jnp.where(v < i32(1), i32(0), bl)
 
         def body(args, use_pallas):
-            alive = args['alive']
-            weights = args['weights']
+            # global row index (for first-occurrence order and, when
+            # the batch is dense, the synthesized alive mask)
+            gidx = jax.lax.iota(jnp.int32, bn)
+            if maxis is not None:
+                gidx = gidx + jax.lax.axis_index(maxis).astype(
+                    jnp.int32) * i32(bn)
+            if gen_alive:
+                # alive synthesized from the record count: rows past
+                # nvalid are padding
+                alive = gidx < args['nvalid']
+            else:
+                alive = args['alive']
+            weights = None if w1 else args['weights']
             counters = []
 
             def isum(x):
@@ -968,9 +1069,10 @@ class DeviceScan(VectorScan):
                 if p.field.startswith('\0synth:'):
                     v = args['ts_' + p.field[len('\0synth:'):]]
                 else:
-                    valid = args['kvalid_' + p.name]
-                    nnon = nnon + isum(alive & ~valid)
-                    alive = alive & valid
+                    if p.name not in kvalid_skip:
+                        valid = args['kvalid_' + p.name]
+                        nnon = nnon + isum(alive & ~valid)
+                        alive = alive & valid
                     v = args['kv_' + p.name]
                 if p.kind == 'p2':
                     codes.append(p2_int(v))
@@ -988,8 +1090,12 @@ class DeviceScan(VectorScan):
                         jax.lax.psum(cvec, maxis))
 
             if not codes:
-                total = jnp.sum(
-                    jnp.where(alive, weights, i32(0)), dtype=jnp.int32)
+                if w1:
+                    total = jnp.sum(alive, dtype=jnp.int32)
+                else:
+                    total = jnp.sum(
+                        jnp.where(alive, weights, i32(0)),
+                        dtype=jnp.int32)
                 dense = total[None]
                 first = jnp.zeros((1,), dtype=jnp.int32)
                 return merge(dense, first, cvec)
@@ -998,21 +1104,21 @@ class DeviceScan(VectorScan):
             for c, cap in zip(codes, caps):
                 fused = fused * i32(cap) + c
             fused = jnp.where(alive, fused, i32(ns))
-            # global row index so cross-shard pmin yields the true
-            # first occurrence (host-engine insertion order)
-            idx = jax.lax.iota(jnp.int32, bn)
-            if maxis is not None:
-                idx = idx + jax.lax.axis_index(maxis).astype(
-                    jnp.int32) * i32(bn)
-            first = jax.ops.segment_min(idx, fused,
+            # global row index (gidx) so cross-shard pmin yields the
+            # true first occurrence (host-engine insertion order)
+            first = jax.ops.segment_min(gidx, fused,
                                         num_segments=ns + 1)[:ns]
             if use_pallas:
+                wf = jnp.ones((bn,), dtype=jnp.float32) if w1 \
+                    else weights.astype(jnp.float32)
                 dense = pk.onehot_dense(
-                    caps, bn, jnp.stack(codes),
-                    weights.astype(jnp.float32), alive,
+                    caps, bn, jnp.stack(codes), wf, alive,
                     interpret=pk.needs_interpret())
             else:
-                w = jnp.where(alive, weights, i32(0))
+                if w1:
+                    w = alive.astype(jnp.int32)
+                else:
+                    w = jnp.where(alive, weights, i32(0))
                 dense = jax.ops.segment_sum(w, fused,
                                             num_segments=ns + 1)[:ns]
             return merge(dense, first, cvec)

@@ -263,6 +263,16 @@ class NativeParser(object):
                 self._np(self.lib.dn_parser_strcodes, field, np.int32,
                          n))
 
+    def tags_col(self, field):
+        """The tags column alone (device path: skips extracting the
+        nums/strcodes columns its upload profile proved dead)."""
+        return self._np(self.lib.dn_parser_tags, field, np.uint8,
+                        self.batch_size())
+
+    def strcodes_col(self, field):
+        return self._np(self.lib.dn_parser_strcodes, field, np.int32,
+                        self.batch_size())
+
     def date_columns(self, field):
         n = self.batch_size()
         return (self._np(self.lib.dn_parser_datesecs, field, np.float64,
