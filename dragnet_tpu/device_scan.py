@@ -7,8 +7,11 @@ the aggregation hash update per record in JS callbacks
 optionally offloads only the final segment-sum.  DeviceScan moves the
 *entire* post-parse pipeline onto the accelerator:
 
-    host:    C++ parse -> tagged columns -> eligibility checks ->
-             upload (i32/u8 columns + small lookup tables)
+    host:    C++ parse -> tagged columns -> one-pass batch stats ->
+             upload (dtype-narrowed columns + small lookup tables;
+             inputs the stats prove constant are synthesized on
+             device instead of uploaded — see the sticky upload
+             profile in _try_device)
     device:  predicate table-gathers + numeric compares -> ternary
              and/or fold -> date-error & time-bounds masks -> p2/linear
              bucketize -> mixed-radix key fusion -> segment-sum (or
@@ -56,6 +59,8 @@ I32MAX = 2 ** 31 - 1
 NUM_FALSE, NUM_TRUE, NUM_EQ, NUM_NE, NUM_LE, NUM_GE = range(6)
 
 I64MAX = 2 ** 63 - 1
+I16MIN = -(2 ** 15)
+I16MAX = 2 ** 15 - 1
 
 # dispatch barrier interval: how many async device batches may be in
 # flight before the submitting thread waits for the accumulator (a
@@ -514,6 +519,30 @@ class DeviceScan(VectorScan):
                 cur[2] = cur[2] and all_num
             return cur
 
+        # dtype narrowing: per-record int columns upload as the
+        # smallest dtype their observed range fits (dictionary codes
+        # are tiny; values like latencies/status codes fit i16), with
+        # the same sticky widening discipline — saves 2-4x of the H2D
+        # bytes the profile didn't already eliminate.  The device
+        # program upcasts to i32 after the transfer.
+        dtypes = sk.setdefault('dtypes', {})
+
+        def _narrow(key, arr, lo, hi):
+            if 0 <= lo and hi <= 255:
+                need = 1
+            elif I16MIN <= lo and hi <= I16MAX:
+                need = 2
+            else:
+                need = 3
+            level = max(dtypes.get(key, need), need)
+            dtypes[key] = level
+            if level == 1:
+                return arr.astype(np.uint8)
+            if level == 2:
+                return arr.astype(np.int16)
+            return arr if arr.dtype == np.int32 \
+                else arr.astype(np.int32)
+
         # filter fields: tags + string codes + exact-i32 numeric
         # values, each uploaded only when this scan has seen rows of
         # that kind in the field
@@ -521,7 +550,7 @@ class DeviceScan(VectorScan):
         for f in self.filter_fields:
             st = _stats(f)
             if st is not None:
-                narr, i32ok, _, _, nnum, nstr = st
+                narr, i32ok, nmn_f, nmx_f, nnum, nstr = st
                 if narr:
                     return False
                 if nnum and not i32ok:
@@ -531,6 +560,7 @@ class DeviceScan(VectorScan):
                 tags = src.tags_col(f) if not all_num else None
                 strcodes = src.strcodes_col(f) if has_str else None
                 iv = src.nums_i32(f) if has_num else None
+                nrange = (int(nmn_f), int(nmx_f)) if nnum else (0, 0)
             else:
                 tags, nums, strcodes = provider._field(f)
                 if (tags == mn.TAG_ARRAY).any():
@@ -548,19 +578,25 @@ class DeviceScan(VectorScan):
                                           .any()), obs_num,
                     bool(m.all()))
                 iv = None
+                nrange = (0, 0)
                 if has_num:
                     iv = np.zeros(n, dtype=np.int32)
                     if obs_num:
                         iv[m] = nums[m].astype(np.int64).astype(
                             np.int32)
+                        nrange = (int(nums[m].min()),
+                                  int(nums[m].max()))
             filter_profile.append((f, has_str, has_num, all_num))
             if not all_num:
                 inputs['tags_' + f] = tags.astype(np.uint8, copy=False)
             if has_str:
-                inputs['str_' + f] = strcodes.astype(np.int32,
-                                                     copy=False)
+                # -1 marks non-string rows (masked on device; any
+                # index works), so the floor of the range is -1
+                dlen = len(src.dictionary(f))
+                inputs['str_' + f] = _narrow('str_' + f, strcodes,
+                                             -1, dlen - 1)
             if has_num:
-                inputs['num_' + f] = iv
+                inputs['num_' + f] = _narrow('num_' + f, iv, *nrange)
 
         # synthetic date fields: combined first-error + needed ts columns
         synth_vals = {}
@@ -627,7 +663,10 @@ class DeviceScan(VectorScan):
                     codes = np.asarray(
                         provider.string_codes(p.name, p.column),
                         dtype=np.int64)
-                    inputs['key_' + p.name] = codes.astype(np.int32)
+                    radix_now = len(p.column.dict.values)
+                    inputs['key_' + p.name] = _narrow(
+                        'key_' + p.name, codes, 0,
+                        max(radix_now - 1, 0))
                 else:
                     from .engine import _native_str_trans
                     trans = _native_str_trans(
@@ -646,8 +685,10 @@ class DeviceScan(VectorScan):
                         self._trans_dev[p.name][1]
                     if strcodes is None:
                         strcodes = src.strcodes_col(p.name)
-                    inputs['str_' + p.name] = strcodes.astype(
-                        np.int32, copy=False)
+                    dlen = len(provider.parser.dictionary(p.name))
+                    inputs['str_' + p.name] = _narrow(
+                        'strk_' + p.name, strcodes, 0, max(dlen - 1,
+                                                           0))
                 radix = len(p.column.dict.values)
                 cap = max(p.cap, _pow2(max(radix, 1)))
                 new_caps.append(cap)
@@ -669,7 +710,10 @@ class DeviceScan(VectorScan):
                         narr, i32ok, nmn, nmx, nnum, _ = st
                         if nnum and not i32ok:
                             return False
-                        inputs['kv_' + p.name] = src.nums_i32(p.name)
+                        inputs['kv_' + p.name] = _narrow(
+                            'kv_' + p.name, src.nums_i32(p.name),
+                            int(nmn) if nnum else 0,
+                            int(nmx) if nnum else 0)
                         kv_skip = sk['kvalid'].get(p.name, True) and \
                             nnum == n
                         sk['kvalid'][p.name] = kv_skip
@@ -692,7 +736,10 @@ class DeviceScan(VectorScan):
                             return False
                         fill = int(vv[0]) if len(vv) else 0
                         v = np.where(valid, vals, fill).astype(np.int64)
-                        inputs['kv_' + p.name] = v.astype(np.int32)
+                        inputs['kv_' + p.name] = _narrow(
+                            'kv_' + p.name, v.astype(np.int32),
+                            int(vv.min()) if len(vv) else 0,
+                            int(vv.max()) if len(vv) else 0)
                         kv_skip = sk['kvalid'].get(p.name, True) and \
                             bool(valid.all())
                         sk['kvalid'][p.name] = kv_skip
@@ -927,13 +974,17 @@ class DeviceScan(VectorScan):
             nshards = 1
             bn = n
 
+        def as_i32(x):
+            # uploads arrive dtype-narrowed (u8/i16); compute in i32
+            return x if x.dtype == jnp.int32 else x.astype(jnp.int32)
+
         def leaf_num_out(i, args, f):
             mode, t = num_plans[i]
             if mode == NUM_FALSE:
                 return jnp.full((bn,), FALSE, dtype=jnp.int8)
             if mode == NUM_TRUE:
                 return jnp.full((bn,), TRUE, dtype=jnp.int8)
-            v = args['num_' + f]
+            v = as_i32(args['num_' + f])
             tt = i32(t)
             if mode == NUM_EQ:
                 hit = v == tt
@@ -956,8 +1007,12 @@ class DeviceScan(VectorScan):
             tags = args['tags_' + f]
             out = args['ctab_%d' % i][tags]
             if has_str:
+                # gather indices must be i32: narrowed i16 codes
+                # overflow JAX's negative-index normalization once the
+                # pow2-padded table exceeds 32767 entries
                 out = jnp.where(tags == mn.TAG_STRING,
-                                args['tab_%d' % i][args['str_' + f]],
+                                args['tab_%d' % i][as_i32(
+                                    args['str_' + f])],
                                 out)
             if not has_num:
                 return out
@@ -1060,11 +1115,11 @@ class DeviceScan(VectorScan):
             for p in plans:
                 if p.kind == 'str':
                     if p.host_translate:
-                        codes.append(args['key_' + p.name])
+                        codes.append(as_i32(args['key_' + p.name]))
                     else:
                         codes.append(
-                            args['trans_' + p.name][args['str_' +
-                                                         p.name]])
+                            args['trans_' + p.name][as_i32(
+                                args['str_' + p.name])])
                     continue
                 if p.field.startswith('\0synth:'):
                     v = args['ts_' + p.field[len('\0synth:'):]]
@@ -1073,7 +1128,7 @@ class DeviceScan(VectorScan):
                         valid = args['kvalid_' + p.name]
                         nnon = nnon + isum(alive & ~valid)
                         alive = alive & valid
-                    v = args['kv_' + p.name]
+                    v = as_i32(args['kv_' + p.name])
                 if p.kind == 'p2':
                     codes.append(p2_int(v))
                 else:

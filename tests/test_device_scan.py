@@ -261,3 +261,37 @@ def test_device_pallas_program(tmp_path, monkeypatch):
     dev_points, _ = _scan(monkeypatch, datafile, qconf, engine='jax',
                           batch=128)
     assert host_points == dev_points
+
+
+def test_large_dictionary_i16_gather(monkeypatch, tmp_path):
+    """Narrowed (i16) string codes indexing a leaf table padded past
+    32767 entries must not overflow JAX's gather index normalization
+    (regression: OverflowError at trace time with 16385-32768-entry
+    dictionaries)."""
+    import json
+    from dragnet_tpu import native as mod_native
+    if mod_native.get_lib() is None:
+        pytest.skip('native parser unavailable')
+    p = tmp_path / 'big_dict.log'
+    nrec = 20000
+    with open(p, 'w') as f:
+        for i in range(nrec):
+            f.write(json.dumps({'k': 'v%05d' % i,
+                                'g': 'a' if i % 2 else 'b'}) + '\n')
+
+    def scan(engine):
+        monkeypatch.setenv('DN_ENGINE', engine)
+        ds = DatasourceFile({
+            'ds_backend': 'file',
+            'ds_backend_config': {'path': str(p)},
+            'ds_filter': None, 'ds_format': 'json',
+        })
+        q = mod_query.query_load({
+            'breakdowns': [{'name': 'g'}],
+            'filter': {'ne': ['k', 'v00042']}})
+        return ds.scan(q).points
+
+    host = scan('host')
+    dev = scan('jax')
+    assert dev == host
+    assert sum(v for _, v in dev) == nrec - 1
