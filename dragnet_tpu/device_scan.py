@@ -683,12 +683,16 @@ class DeviceScan(VectorScan):
                         self._trans_dev[p.name] = (len(trans), dev)
                     inputs['trans_' + p.name] = \
                         self._trans_dev[p.name][1]
-                    if strcodes is None:
-                        strcodes = src.strcodes_col(p.name)
-                    dlen = len(provider.parser.dictionary(p.name))
-                    inputs['str_' + p.name] = _narrow(
-                        'strk_' + p.name, strcodes, 0, max(dlen - 1,
-                                                           0))
+                    if ('str_' + p.name) not in inputs:
+                        # (a field that is both filter and breakdown
+                        # reuses the filter loop's upload — one sticky
+                        # key per physical input)
+                        if strcodes is None:
+                            strcodes = src.strcodes_col(p.name)
+                        dlen = len(provider.parser.dictionary(p.name))
+                        inputs['str_' + p.name] = _narrow(
+                            'str_' + p.name, strcodes, 0,
+                            max(dlen - 1, 0))
                 radix = len(p.column.dict.values)
                 cap = max(p.cap, _pow2(max(radix, 1)))
                 new_caps.append(cap)
@@ -1254,10 +1258,11 @@ class DeviceScan(VectorScan):
         self._acc = None
         self._acc_meta = None
         self._acc_batch = 0
-        # visible proof (--counters) of which engine produced the
-        # result: batches folded on the device this epoch
+        # engine telemetry: batches folded on the device this epoch
+        # (programmatic — Stage.counters / the cluster tests — but
+        # kept out of the --counters dump for golden byte parity)
         if nbatches:
-            self.aggr.stage.bump('ndevicebatches', nbatches)
+            self.aggr.stage.bump_hidden('ndevicebatches', nbatches)
         for a in acc:
             if hasattr(a, 'copy_to_host_async'):
                 try:

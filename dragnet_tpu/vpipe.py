@@ -20,6 +20,7 @@ class Stage(object):
     def __init__(self, name, pipeline=None):
         self.name = name
         self.counters = {}
+        self.hidden = set()    # telemetry counters kept out of dump()
         self.pipeline = pipeline
 
     def bump(self, counter, n=1):
@@ -30,10 +31,18 @@ class Stage(object):
         if self.pipeline is not None and self.pipeline.warn_func is not None:
             self.pipeline.warn_func(self, kind, error)
 
+    def bump_hidden(self, counter, n=1):
+        """Bump a telemetry counter that stays out of the --counters
+        dump (whose byte format is pinned to the reference goldens
+        regardless of engine); still visible programmatically via
+        Stage.counters."""
+        self.hidden.add(counter)
+        self.bump(counter, n)
+
     def dump(self, out):
         for counter in sorted(self.counters):
             value = self.counters[counter]
-            if value == 0:
+            if value == 0 or counter in self.hidden:
                 continue
             out.write('%-18s %-13s%8d\n' % (self.name, counter + ':', value))
 

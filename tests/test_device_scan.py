@@ -279,19 +279,26 @@ def test_large_dictionary_i16_gather(monkeypatch, tmp_path):
             f.write(json.dumps({'k': 'v%05d' % i,
                                 'g': 'a' if i % 2 else 'b'}) + '\n')
 
-    def scan(engine):
+    def scan(engine, qconf):
         monkeypatch.setenv('DN_ENGINE', engine)
         ds = DatasourceFile({
             'ds_backend': 'file',
             'ds_backend_config': {'path': str(p)},
             'ds_filter': None, 'ds_format': 'json',
         })
-        q = mod_query.query_load({
-            'breakdowns': [{'name': 'g'}],
-            'filter': {'ne': ['k', 'v00042']}})
-        return ds.scan(q).points
+        return ds.scan(mod_query.query_load(dict(qconf))).points
 
-    host = scan('host')
-    dev = scan('jax')
+    # filter leaf-table gather at >16384 dictionary entries
+    q1 = {'breakdowns': [{'name': 'g'}],
+          'filter': {'ne': ['k', 'v00042']}}
+    host = scan('host', q1)
+    dev = scan('jax', q1)
     assert dev == host
     assert sum(v for _, v in dev) == nrec - 1
+
+    # translate-table gather: breakdown BY the 20k-entry field
+    q2 = {'breakdowns': [{'name': 'k'}],
+          'filter': {'eq': ['g', 'a']}}
+    host2 = scan('host', q2)
+    dev2 = scan('jax', q2)
+    assert dev2 == host2
