@@ -246,6 +246,22 @@ def main():
     auto_large_rps, np_auto = _timed_scan(largefile, large_n, None)
     assert np_dev == np_auto == np_host, 'engine outputs diverge'
 
+    # high-cardinality group-by: output tuples ~ records (url x raw
+    # latency), exercising the sparse/deferred merge path whose memory
+    # is bounded by unique tuples (the reference's scaling law,
+    # README.md:668-681)
+    hc_query = {'breakdowns': [{'name': 'req.url'},
+                               {'name': 'latency'}]}
+    run_scan(datafile, mod_query.query_load(dict(hc_query)))  # warm
+    hc_s = float('inf')
+    for _ in range(2):
+        t0 = time.time()
+        hc_result = run_scan(datafile,
+                             mod_query.query_load(dict(hc_query)))
+        hc_s = min(hc_s, time.time() - t0)
+    hc_rps = nrecords / hc_s
+    hc_tuples = len(hc_result.points)
+
     build_rps, query_p50 = run_build_query(datafile, nrecords)
 
     vec_rps = nrecords / vec_s
@@ -255,10 +271,12 @@ def main():
         'bench: %d records, %d output points; gen %.1fs; '
         'dn-scan %.2fs (%.0f rec/s); host-sample %.2fs (%.0f rec/s); '
         'large(%d): host %.0f, device %.0f, auto %.0f rec/s; '
+        'highcard %.0f rec/s (%d tuples); '
         'dn-build %.0f rec/s; index-query p50 %.1fms; '
         'native=%s threads=%s\n'
         % (nrecords, npoints, gen_s, vec_s, vec_rps, host_s, host_rps,
            large_n, host_large_rps, device_rps, auto_large_rps,
+           hc_rps, hc_tuples,
            build_rps, query_p50 * 1000,
            os.environ.get('DN_NATIVE', '1'),
            os.environ.get('DN_SCAN_THREADS', 'auto')))
@@ -275,6 +293,8 @@ def main():
             'host_large_records_per_sec': round(host_large_rps),
             'device_large_records_per_sec': round(device_rps),
             'auto_large_records_per_sec': round(auto_large_rps),
+            'highcard_records_per_sec': round(hc_rps),
+            'highcard_output_tuples': hc_tuples,
             'build_records_per_sec': round(build_rps),
             'index_query_p50_ms': round(query_p50 * 1000, 2),
         },

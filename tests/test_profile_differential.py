@@ -1,0 +1,137 @@
+"""Differential fuzz for the device path's sticky upload profiles and
+dtype narrowing: streams engineered to flip every profile flag and
+widening boundary mid-scan (numeric-only fields growing strings,
+dictionaries crossing the u8 code boundary, values crossing i16,
+validity masks appearing late, weights departing from 1) must produce
+byte-identical results and counters on the device and host engines.
+Phased data maximizes mid-stream program-variant switches — exactly
+where a stale sticky flag or a narrowing bug would diverge."""
+
+import json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import native as mod_native  # noqa: E402
+from dragnet_tpu import query as mod_query  # noqa: E402
+from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
+from dragnet_tpu.ops import get_jax, backend_ready  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    mod_native.get_lib() is None or get_jax() is None or
+    not backend_ready(),
+    reason='native parser or jax unavailable')
+
+
+def _phase_lines(rng, phase, n):
+    """Records whose shape depends on the phase index, so profile
+    flags observed early are violated later."""
+    lines = []
+    for i in range(n):
+        rec = {}
+        # 'v': numeric-only early; strings and junk appear in phase 2+
+        if phase == 0:
+            rec['v'] = rng.randrange(0, 200)              # u8-ish
+        elif phase == 1:
+            rec['v'] = rng.randrange(-40000, 40000)       # breaks i16
+        else:
+            rec['v'] = rng.choice(
+                [rng.randrange(0, 100), '17', 'junk', None, True])
+        # 'k': dictionary grows across phases (crosses 256 codes)
+        span = 40 if phase == 0 else 600
+        rec['k'] = 'k%04d' % rng.randrange(span)
+        # 'lat': always-valid early, invalid rows later
+        if phase < 2 or rng.random() < 0.8:
+            rec['lat'] = rng.choice([1, 5, 80, 3000, 40000])
+        else:
+            rec['lat'] = rng.choice(['x', None])
+        lines.append(json.dumps(rec))
+    return lines
+
+
+QUERIES = [
+    {'breakdowns': [{'name': 'k'}],
+     'filter': {'le': ['v', 150]}},
+    {'breakdowns': [{'name': 'k'},
+                    {'name': 'lat', 'aggr': 'quantize'}]},
+    {'breakdowns': [{'name': 'lat', 'aggr': 'lquantize',
+                     'step': 500}],
+     'filter': {'ne': ['v', 17]}},
+    {'breakdowns': [{'name': 'v'}]},
+]
+
+
+def _scan(monkeypatch, datafile, qconf, engine):
+    monkeypatch.setenv('DN_ENGINE', engine)
+    monkeypatch.setenv('DN_SCAN_THREADS', '0')
+    monkeypatch.setenv('DN_READ_SIZE', '16384')
+    from dragnet_tpu import engine as mod_engine
+    from dragnet_tpu import device_scan as mod_ds
+    monkeypatch.setattr(mod_engine, 'BATCH_SIZE', 256)
+    monkeypatch.setattr(mod_ds, 'BATCH_SIZE', 256)
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile},
+        'ds_filter': None, 'ds_format': 'json',
+    })
+    r = ds.scan(mod_query.query_load(dict(qconf)))
+    counters = {(s.name, k): v for s in r.pipeline.stages
+                for k, v in s.counters.items()
+                if v and k != 'ndevicebatches'}
+    return r.points, counters
+
+
+@pytest.mark.parametrize('qi', range(len(QUERIES)))
+@pytest.mark.parametrize('seed', [1, 2])
+def test_profile_flip_differential(tmp_path, monkeypatch, qi, seed):
+    rng = random.Random(1000 * seed + qi)
+    lines = []
+    for phase in (0, 1, 2, 0):     # return to narrow data at the end
+        lines.extend(_phase_lines(rng, phase, 400))
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    qconf = QUERIES[qi]
+    host_points, host_counters = _scan(monkeypatch, datafile, qconf,
+                                       'host')
+    dev_points, dev_counters = _scan(monkeypatch, datafile, qconf,
+                                     'jax')
+    assert host_points == dev_points, (qi, seed)
+    assert host_counters == dev_counters, (qi, seed)
+
+
+def test_skinner_weights_profile(tmp_path, monkeypatch):
+    """json-skinner input: weights start at 1 (w1 profile) then vary,
+    forcing the sticky weights widening mid-stream."""
+    lines = []
+    rng = random.Random(3)
+    for i in range(2000):
+        w = 1 if i < 700 else rng.choice([1, 2, 7, 100])
+        lines.append(json.dumps(
+            {'fields': {'k': 'k%d' % rng.randrange(30)}, 'value': w}))
+    datafile = str(tmp_path / 'sk.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+
+    def scan(engine):
+        monkeypatch.setenv('DN_ENGINE', engine)
+        monkeypatch.setenv('DN_SCAN_THREADS', '0')
+        monkeypatch.setenv('DN_READ_SIZE', '8192')
+        from dragnet_tpu import engine as mod_engine
+        from dragnet_tpu import device_scan as mod_ds
+        monkeypatch.setattr(mod_engine, 'BATCH_SIZE', 256)
+        monkeypatch.setattr(mod_ds, 'BATCH_SIZE', 256)
+        ds = DatasourceFile({
+            'ds_backend': 'file',
+            'ds_backend_config': {'path': datafile},
+            'ds_filter': None, 'ds_format': 'json-skinner',
+        })
+        q = mod_query.query_load({'breakdowns': [{'name': 'k'}]})
+        return ds.scan(q).points
+
+    assert scan('jax') == scan('host')
