@@ -1,0 +1,39 @@
+"""Shared engine-differential scan helper: run a DatasourceFile scan
+with a pinned engine and small batches, returning (points, counters)
+with engine telemetry ('ndevicebatches' & co.) excluded from the
+counter-parity set."""
+
+import sys
+
+
+def scan_points_counters(monkeypatch, datafile, qconf, engine,
+                         batch=None, read_size=None, fmt='json',
+                         time_field=None, ds_filter=None,
+                         scan_threads='0'):
+    from dragnet_tpu import query as mod_query
+    from dragnet_tpu.datasource_file import DatasourceFile
+
+    monkeypatch.setenv('DN_ENGINE', engine)
+    monkeypatch.setenv('DN_NATIVE', '1')
+    monkeypatch.setenv('DN_SCAN_THREADS', scan_threads)
+    if read_size is not None:
+        monkeypatch.setenv('DN_READ_SIZE', str(read_size))
+    if batch is not None:
+        from dragnet_tpu import engine as mod_engine
+        from dragnet_tpu import device_scan as mod_ds
+        monkeypatch.setattr(mod_engine, 'BATCH_SIZE', batch)
+        monkeypatch.setattr(mod_ds, 'BATCH_SIZE', batch)
+    bc = {'path': datafile}
+    if time_field is not None:
+        bc['timeField'] = time_field
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': bc,
+        'ds_filter': ds_filter,
+        'ds_format': fmt,
+    })
+    r = ds.scan(mod_query.query_load(dict(qconf)))
+    counters = {(s.name, k): v for s in r.pipeline.stages
+                for k, v in s.counters.items()
+                if v and k not in s.hidden}
+    return r.points, counters

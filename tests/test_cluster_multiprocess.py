@@ -51,10 +51,18 @@ def _run_workers(args, timeout=180):
     contract), so timeouts FAIL rather than skip."""
     port = _free_port()
     env = dict(os.environ)
+    # 4 virtual devices per process: the full hierarchy — the scan
+    # pipeline shard_map'd over each process's local mesh (the ICI
+    # analog) + the cross-process points allgather (the DCN analog).
+    # Append to inherited XLA_FLAGS (conftest.py models this pattern).
+    xla = env.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in xla:
+        xla = (xla + ' --xla_force_host_platform_device_count=4').strip()
     env.update({
         'DN_COORDINATOR': '127.0.0.1:%d' % port,
         'DN_NUM_PROCESSES': '2',
         'JAX_PLATFORMS': 'cpu',
+        'XLA_FLAGS': xla,
     })
     procs = []
     for pid in range(2):

@@ -100,29 +100,14 @@ QUERIES = [
 ]
 
 
+from helpers.scan_differential import scan_points_counters  # noqa: E402
+
+
 def _scan(monkeypatch, datafile, qconf, engine, batch=None):
-    monkeypatch.setenv('DN_ENGINE', engine)
-    monkeypatch.setenv('DN_NATIVE', '1')
-    monkeypatch.setenv('DN_SCAN_THREADS', '0')
     monkeypatch.setenv('DN_PARSE_THREADS', '1')
-    if batch is not None:
-        from dragnet_tpu import engine as mod_engine
-        from dragnet_tpu import device_scan as mod_ds
-        monkeypatch.setattr(mod_engine, 'BATCH_SIZE', batch)
-        monkeypatch.setattr(mod_ds, 'BATCH_SIZE', batch)
-    ds = DatasourceFile({
-        'ds_backend': 'file',
-        'ds_backend_config': {'path': datafile, 'timeField': 'time'},
-        'ds_filter': {'ne': ['host', 'zzz']},
-        'ds_format': 'json',
-    })
-    r = ds.scan(mod_query.query_load(dict(qconf)))
-    # 'ndevicebatches' is engine telemetry (which engine folded the
-    # batches), not a semantic counter — excluded from the parity set
-    counters = {(s.name, k): v for s in r.pipeline.stages
-                for k, v in s.counters.items()
-                if v and k != 'ndevicebatches'}
-    return r.points, counters
+    return scan_points_counters(
+        monkeypatch, datafile, qconf, engine, batch=batch,
+        time_field='time', ds_filter={'ne': ['host', 'zzz']})
 
 
 @pytest.mark.parametrize('qi', range(len(QUERIES)))

@@ -66,24 +66,12 @@ QUERIES = [
 ]
 
 
+from helpers.scan_differential import scan_points_counters  # noqa: E402
+
+
 def _scan(monkeypatch, datafile, qconf, engine):
-    monkeypatch.setenv('DN_ENGINE', engine)
-    monkeypatch.setenv('DN_SCAN_THREADS', '0')
-    monkeypatch.setenv('DN_READ_SIZE', '16384')
-    from dragnet_tpu import engine as mod_engine
-    from dragnet_tpu import device_scan as mod_ds
-    monkeypatch.setattr(mod_engine, 'BATCH_SIZE', 256)
-    monkeypatch.setattr(mod_ds, 'BATCH_SIZE', 256)
-    ds = DatasourceFile({
-        'ds_backend': 'file',
-        'ds_backend_config': {'path': datafile},
-        'ds_filter': None, 'ds_format': 'json',
-    })
-    r = ds.scan(mod_query.query_load(dict(qconf)))
-    counters = {(s.name, k): v for s in r.pipeline.stages
-                for k, v in s.counters.items()
-                if v and k != 'ndevicebatches'}
-    return r.points, counters
+    return scan_points_counters(monkeypatch, datafile, qconf, engine,
+                                batch=256, read_size=16384)
 
 
 @pytest.mark.parametrize('qi', range(len(QUERIES)))
@@ -119,19 +107,9 @@ def test_skinner_weights_profile(tmp_path, monkeypatch):
         f.write('\n'.join(lines) + '\n')
 
     def scan(engine):
-        monkeypatch.setenv('DN_ENGINE', engine)
-        monkeypatch.setenv('DN_SCAN_THREADS', '0')
-        monkeypatch.setenv('DN_READ_SIZE', '8192')
-        from dragnet_tpu import engine as mod_engine
-        from dragnet_tpu import device_scan as mod_ds
-        monkeypatch.setattr(mod_engine, 'BATCH_SIZE', 256)
-        monkeypatch.setattr(mod_ds, 'BATCH_SIZE', 256)
-        ds = DatasourceFile({
-            'ds_backend': 'file',
-            'ds_backend_config': {'path': datafile},
-            'ds_filter': None, 'ds_format': 'json-skinner',
-        })
-        q = mod_query.query_load({'breakdowns': [{'name': 'k'}]})
-        return ds.scan(q).points
+        pts, _ = scan_points_counters(
+            monkeypatch, datafile, {'breakdowns': [{'name': 'k'}]},
+            engine, batch=256, read_size=8192, fmt='json-skinner')
+        return pts
 
     assert scan('jax') == scan('host')
