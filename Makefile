@@ -17,7 +17,9 @@ test: native
 check:
 	$(PYTHON) -m compileall -q dragnet_tpu bin/dn.py bench.py \
 	    __graft_entry__.py tests
-	$(PYTHON) tools/checkstyle dragnet_tpu bin tools/checkstyle \
+	$(PYTHON) tools/checkstyle dragnet_tpu bin tests \
+	    tools/checkstyle tools/json_streamer tools/pathenum \
+	    tools/validate-schema tools/profile_device tools/mktestdata \
 	    bench.py __graft_entry__.py
 
 bench: native

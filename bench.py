@@ -192,7 +192,15 @@ def _timed_scan(datafile, nrecords, engine, repeats=2):
             os.environ.pop('DN_ENGINE', None)
         else:
             os.environ['DN_ENGINE'] = prior
-    return nrecords / best, len(result.points)
+    # engine telemetry: did the device program actually fold batches,
+    # or did the scan silently fall back to the host path (no usable
+    # backend)?  Recording a fallback as a 'device' number would
+    # corrupt round-over-round regression tracking.
+    ndev = 0
+    for stage in result.pipeline.stages:
+        if stage.name == 'Aggregator':
+            ndev = stage.counters.get('ndevicebatches', 0)
+    return nrecords / best, len(result.points), ndev
 
 
 def main():
@@ -241,10 +249,13 @@ def main():
 
     # the large-scan trio: vectorized host engine (no device routing),
     # forced device, and the auto router's own choice
-    host_large_rps, np_host = _timed_scan(largefile, large_n, 'vector')
-    device_rps, np_dev = _timed_scan(largefile, large_n, 'jax')
-    auto_large_rps, np_auto = _timed_scan(largefile, large_n, None)
+    host_large_rps, np_host, _ = _timed_scan(largefile, large_n,
+                                             'vector')
+    device_rps, np_dev, dev_batches = _timed_scan(largefile, large_n,
+                                                  'jax')
+    auto_large_rps, np_auto, _ = _timed_scan(largefile, large_n, None)
     assert np_dev == np_auto == np_host, 'engine outputs diverge'
+    device_engaged = dev_batches > 0
 
     # high-cardinality group-by: output tuples ~ records (url x raw
     # latency), exercising the sparse/deferred merge path whose memory
@@ -291,7 +302,9 @@ def main():
         'extra': {
             'large_records': large_n,
             'host_large_records_per_sec': round(host_large_rps),
-            'device_large_records_per_sec': round(device_rps),
+            'device_large_records_per_sec':
+                round(device_rps) if device_engaged else None,
+            'device_path_engaged': device_engaged,
             'auto_large_records_per_sec': round(auto_large_rps),
             'highcard_records_per_sec': round(hc_rps),
             'highcard_output_tuples': hc_tuples,

@@ -282,7 +282,11 @@ def load_config(inp):
     for dsconfig in inp['datasources']:
         dc.dc_datasources[dsconfig['name']] = {
             'ds_backend': dsconfig['backend'],
-            'ds_backend_config': dsconfig['backend_config'],
+            # typeof null === 'object' passes the schema (faithful to
+            # the reference), but every consumer dereferences this as
+            # a dict — coerce so a hand-edited null yields the normal
+            # 'expected datasource "path"...' DNError, not a traceback
+            'ds_backend_config': dsconfig['backend_config'] or {},
             'ds_filter': dsconfig.get('filter'),
             'ds_format': dsconfig.get('dataFormat'),
         }

@@ -30,6 +30,12 @@ _NAMES = {'trace': TRACE, 'debug': DEBUG, 'info': INFO,
           'warn': WARN, 'error': ERROR, 'fatal': FATAL}
 
 
+def _iso_now():
+    t = time.time()     # one clock read: seconds and millis agree
+    return time.strftime('%Y-%m-%dT%H:%M:%S', time.gmtime(t)) + \
+        ('.%03dZ' % (int(t * 1000) % 1000))
+
+
 def _env_level():
     """LOG_LEVEL by name or bunyan numeric value; default warn."""
     raw = (os.environ.get('LOG_LEVEL') or 'warn').strip().lower()
@@ -71,9 +77,7 @@ class Logger(object):
             'pid': os.getpid(),
             'level': level,
             'msg': msg,
-            'time': time.strftime('%Y-%m-%dT%H:%M:%S',
-                                  time.gmtime()) +
-                    ('.%03dZ' % (int(time.time() * 1000) % 1000)),
+            'time': _iso_now(),
             'v': 0,
         }
         if self.component is not None:

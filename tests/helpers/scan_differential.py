@@ -3,8 +3,6 @@ with a pinned engine and small batches, returning (points, counters)
 with engine telemetry ('ndevicebatches' & co.) excluded from the
 counter-parity set."""
 
-import sys
-
 
 def scan_points_counters(monkeypatch, datafile, qconf, engine,
                          batch=None, read_size=None, fmt='json',

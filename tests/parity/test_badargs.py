@@ -4,7 +4,7 @@
 import os
 import pytest
 
-from .runner import DnRunner, DATADIR, golden, have_reference, assert_golden
+from .runner import DnRunner, DATADIR, have_reference, assert_golden
 
 pytestmark = pytest.mark.skipif(not have_reference(),
                                 reason='reference checkout not available')

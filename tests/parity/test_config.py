@@ -3,7 +3,7 @@
 
 import pytest
 
-from .runner import DnRunner, golden, have_reference, assert_golden
+from .runner import DnRunner, have_reference, assert_golden
 
 pytestmark = pytest.mark.skipif(not have_reference(),
                                 reason='reference checkout not available')

@@ -4,7 +4,7 @@
 import os
 import pytest
 
-from .runner import DnRunner, DATADIR, golden, have_reference, \
+from .runner import DnRunner, DATADIR, have_reference, \
     scan_testcases, assert_golden
 
 pytestmark = pytest.mark.skipif(not have_reference(),

@@ -124,14 +124,20 @@ class _Eager(device_scan.AutoDeviceScan):
 def test_mt_takeover_identical_results(datafile, expected, monkeypatch):
     """The device path auditions, takes over mid-stream from the MT
     executor, and the merged output is byte-identical to the host
-    engine."""
-    result, instances = _scan(datafile, _Eager, monkeypatch)
-    assert result.points == expected
-    assert len(instances) == 1
-    s = instances[0]
+    engine.  The audition runs on a background thread racing a short
+    stream, so on loaded machines the takeover may not land on the
+    first scan — retry a few times; every attempt must be correct."""
+    s = None
+    for attempt in range(4):
+        result, instances = _scan(datafile, _Eager, monkeypatch)
+        assert result.points == expected
+        assert len(instances) == 1
+        s = instances[0]
+        assert s._acc is None      # flushed by finish()
+        if s._escalated:
+            break
     assert s._escalated, 'device path never took over the stream'
     assert s._shadow is not None and s._shadow.done
-    assert s._acc is None          # flushed by finish()
 
 
 def test_audition_loss_never_disturbs_stream(datafile, expected,
@@ -198,10 +204,15 @@ def test_small_scan_never_switches(datafile, expected, monkeypatch):
 def test_nonmt_async_escalation(datafile, expected, monkeypatch):
     """DN_SCAN_THREADS=0 (no executor): the scanner itself escalates
     via the async probe without ever blocking the stream — no shadow
-    audition on this path (there is no executor to protect)."""
-    result, instances = _scan(datafile, _Eager, monkeypatch,
-                              threads='0')
-    assert result.points == expected
-    s = instances[0]
-    assert s._shadow is None
+    audition on this path (there is no executor to protect).  Retried
+    like the takeover test: the probe thread races a short stream."""
+    s = None
+    for attempt in range(4):
+        result, instances = _scan(datafile, _Eager, monkeypatch,
+                                  threads='0')
+        assert result.points == expected
+        s = instances[0]
+        assert s._shadow is None
+        if s._escalated:
+            break
     assert s._escalated

@@ -3,7 +3,6 @@ bounded by unique output tuples, not input length (the reference gates
 max RSS at 90 MB for Node via tests/dn/local/tst.scan_250k.sh; our gate
 is growth-based because the interpreter baseline differs per image)."""
 
-import json
 import os
 import resource
 import sys
