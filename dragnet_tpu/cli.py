@@ -435,6 +435,15 @@ def dn_output(query, opts, result, dsname):
     """(reference: bin/dn:924-967)"""
     pipeline = result.pipeline
 
+    # multi-process SPMD runs: every process computes the full result
+    # (allgather), but only process 0 prints it — the analog of the
+    # reference's client fetching the single job output.  Dry-run plans
+    # still print everywhere: each process's plan shows ITS partition.
+    if result.dry_run_files is None:
+        from .parallel import distributed as mod_dist
+        if not mod_dist.is_output_process():
+            return
+
     if result.dry_run_files is not None:
         plan = getattr(result, 'dry_run_plan', None)
         if plan is not None:
@@ -565,9 +574,11 @@ def cmd_build(ctx, argv):
     if opts.dry_run:
         dn_output(None, opts, result, dsname)
         return
-    sys.stderr.write('indexes for "%s" built\n' % dsname)
-    if getattr(opts, 'counters', None):
-        result.pipeline.dump_counters(sys.stderr)
+    from .parallel import distributed as mod_dist
+    if mod_dist.is_output_process():
+        sys.stderr.write('indexes for "%s" built\n' % dsname)
+        if getattr(opts, 'counters', None):
+            result.pipeline.dump_counters(sys.stderr)
 
 
 def cmd_index_config(ctx, argv):

@@ -34,6 +34,17 @@ def get_jax():
         try:
             import os
             import jax
+            # a deployment site hook may set the jax_platforms CONFIG
+            # (which outranks the env var) to pin its device plugin;
+            # restore stock jax behavior — an explicit JAX_PLATFORMS in
+            # the environment wins — so multi-process CPU runs under
+            # such a deployment initialize the backend they asked for
+            env_platforms = os.environ.get('JAX_PLATFORMS')
+            if env_platforms:
+                try:
+                    jax.config.update('jax_platforms', env_platforms)
+                except Exception:
+                    pass   # backend already initialized: too late
             jax.config.update('jax_enable_x64', True)
             if os.environ.get('DN_XLA_CACHE', '1') != '0':
                 # persistent XLA compile cache: a CLI process pays the

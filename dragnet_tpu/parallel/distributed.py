@@ -71,5 +71,17 @@ def partition_files(files, num_processes, process_id):
 
 
 def is_output_process():
+    """Whether this process should print results (process 0; trivially
+    true single-process).  The common case — no distributed env, no
+    initialized runtime — answers WITHOUT importing jax: CLI output
+    paths call this on every command, and a host-engine scan must not
+    pay jax import (let alone distributed initialization) at print
+    time.  With DN_COORDINATOR exported the launch is explicitly
+    distributed and the full check is the point."""
+    if not os.environ.get('DN_COORDINATOR') and not _initialized:
+        import sys
+        jax = sys.modules.get('jax')
+        if jax is None or not _jax_dist_initialized(jax):
+            return True
     _, pid = maybe_initialize()
     return pid == 0

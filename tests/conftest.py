@@ -5,7 +5,10 @@ import sys
 # may have imported jax before this conftest runs (sitecustomize), so
 # setting env vars alone is not enough — also force the config keys if
 # jax is already imported but its backend is not yet initialized.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# force (not setdefault): deployment environments export
+# JAX_PLATFORMS=<device plugin>, and ops.get_jax honors the env var
+# over any config a site hook set — tests must run on the CPU mesh
+os.environ['JAX_PLATFORMS'] = 'cpu'
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (

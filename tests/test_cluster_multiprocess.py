@@ -221,3 +221,65 @@ def test_build_write_failure_releases_barrier(tmp_path):
     # process 1 either saw no error (write happens on 0 only) or the
     # same propagated failure — but it DID exit; the hang is the bug
     assert set(by_pid) == {0, 1}
+
+
+@pytest.mark.slow
+@pytest.mark.multichip
+def test_two_process_cli_scan(tmp_path):
+    """The distributed protocol IS the CLI (the reference re-invoked
+    `dn` inside job containers): running `bin/dn scan` on every
+    process with the cluster env set must print the full result from
+    process 0 only, byte-identical to a single-process run."""
+    datadir = tmp_path / 'data'
+    datadir.mkdir()
+    _write_data(datadir)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    dn = os.path.join(root, 'bin', 'dn.py')
+    rcfile = tmp_path / 'rc'
+
+    base_env = dict(os.environ, DRAGNET_CONFIG=str(rcfile),
+                    JAX_PLATFORMS='cpu')
+    subprocess.run(
+        [sys.executable, dn, 'datasource-add', 'cl',
+         '--backend=cluster', '--path=%s' % datadir,
+         '--time-field=time'],
+        check=True, env=base_env, capture_output=True)
+
+    # single-process reference output
+    single = subprocess.run(
+        [sys.executable, dn, 'scan', '-b',
+         'host,latency[aggr=quantize]', 'cl'],
+        check=True, env=base_env, capture_output=True)
+
+    port = _free_port()
+    env = dict(base_env, DN_COORDINATOR='127.0.0.1:%d' % port,
+               DN_NUM_PROCESSES='2')
+    procs = []
+    for pid in range(2):
+        e = dict(env, DN_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, dn, 'scan', '-b',
+             'host,latency[aggr=quantize]', 'cl'],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=e))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail('dn worker hung')
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err.decode()[-2000:]
+
+    def sans_backend_noise(raw):
+        # the CPU collectives backend (Gloo) writes a rank banner to
+        # stdout; on TPU deployments collectives ride ICI and no such
+        # banner exists
+        return b''.join(ln for ln in raw.splitlines(keepends=True)
+                        if not ln.startswith(b'[Gloo]'))
+
+    # process 0 prints the full result; process 1 prints nothing
+    assert sans_backend_noise(outs[0][1]) == single.stdout
+    assert sans_backend_noise(outs[1][1]) == b''
