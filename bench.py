@@ -172,7 +172,7 @@ def run_build_query(datafile, nrecords):
     return nrecords / build_s, times[len(times) // 2]
 
 
-def _timed_scan(datafile, nrecords, engine, repeats=2):
+def _timed_scan(datafile, nrecords, engine, repeats=3):
     """Engine-pinned scan over datafile; best-of-N records/sec (the
     same noise policy for every engine, so the side-by-side numbers in
     BENCH_r*.json stay comparable)."""
