@@ -131,12 +131,14 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         if error is not None:
             raise error
 
+        # index_scan (overridden below) already allgather-merges, so
+        # every process holds the complete tagged aggregate here
         result = self.index_scan(metrics, interval,
                                  filter=self.ds_filter,
                                  time_after=time_after,
                                  time_before=time_before,
                                  warn_func=warn_func)
-        merged = _allgather_merge_tagged(result.points)
+        merged = result.points
         # the barrier must be reached even if the write fails, or every
         # other process hangs in sync_global_devices until the
         # distributed-runtime heartbeat timeout
@@ -172,6 +174,24 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         if nprocs <= 1 or result.points is None:
             return result
         result.points = _allgather_merge_points(query, result.points)
+        return result
+
+    def index_scan(self, metrics, interval, filter=None, time_after=None,
+                   time_before=None, warn_func=None):
+        """Distributed index-scan: each process scans its file partition
+        (the _find override), then the __dn_metric-tagged partial
+        aggregates merge across processes.  Without this merge a
+        cluster `dn index-scan` would print only process 0's partition
+        as if it were complete (the CLI output protocol prints from
+        process 0 only) — in the reference, map-phase points always
+        reached a reduce consumer (lib/datasource-manta.js:36-44)."""
+        result = super(DatasourceCluster, self).index_scan(
+            metrics, interval, filter=filter, time_after=time_after,
+            time_before=time_before, warn_func=warn_func)
+        nprocs, pid = mod_dist.maybe_initialize()
+        if nprocs <= 1 or result.points is None:
+            return result
+        result.points = _allgather_merge_tagged(result.points)
         return result
 
     def query(self, query, interval, dry_run=False):

@@ -10,6 +10,9 @@ line.  Modes:
                                  parallel/cluster.py)
     query DATADIR INDEXDIR       distributed index query (partitioned
                                  index files + allgather merge)
+    index_scan DATADIR           distributed index-scan: tagged points
+                                 must be the COMPLETE merged aggregate
+                                 on every process, not one partition
 """
 
 import json
@@ -75,6 +78,10 @@ def main():
             out['error'] = None
         except Exception as e:
             out['error'] = '%s: %s' % (type(e).__name__, e)
+    elif mode == 'index_scan':
+        metric = mod_query.metric_deserialize(METRIC)
+        result = _ds(datadir).index_scan([metric], 'day')
+        out['points'] = result.points
     elif mode == 'query':
         indexdir = sys.argv[3]
         result = _ds(datadir, indexdir).query(

@@ -182,6 +182,30 @@ def test_two_process_build_byte_identical(tmp_path):
 
 @pytest.mark.slow
 @pytest.mark.multichip
+def test_two_process_index_scan_merged(tmp_path):
+    """A cluster `dn index-scan` must emit the COMPLETE merged tagged
+    aggregate, byte-equal to a single-process index-scan — not just
+    process 0's file partition (the round-4 bug: _find partitioned but
+    index_scan never merged, so the process-0-only output protocol
+    printed a partial result as if complete)."""
+    datadir = tmp_path / 'data'
+    datadir.mkdir()
+    _write_data(datadir)
+
+    results = _run_workers(['index_scan', str(datadir)])
+    assert all(r['nprocs'] == 2 for r in results)
+
+    expected = [[f, v] for f, v in
+                _file_ds(datadir).index_scan([_metric()], 'day').points]
+    assert len(expected) > 0
+    for r in results:
+        # full merge, and insertion order preserved: byte-equality,
+        # not set-equality
+        assert r['points'] == expected
+
+
+@pytest.mark.slow
+@pytest.mark.multichip
 def test_two_process_distributed_query(tmp_path):
     """Index queries partition the index files across processes and
     merge partial aggregates — same reduce as scan (the reference ran
