@@ -51,6 +51,10 @@ class Pipeline(object):
     def __init__(self):
         self.stages = []
         self.warn_func = None
+        # lost-work forensics: the watchdog dumps these counters if the
+        # process exits with un-merged work (watchdog.py)
+        from . import watchdog
+        watchdog.register_pipeline(self)
 
     def stage(self, name):
         s = Stage(name, self)

@@ -1,0 +1,108 @@
+"""Premature-exit watchdog: lost work must be loud, and the report must
+include per-stage pipeline counters — the reference printed counters +
+pipeline debug dumps on abnormal exit (bin/dn:1290-1311)."""
+
+import io
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import watchdog
+from dragnet_tpu.vpipe import Pipeline
+
+
+class FakeScan(object):
+    def __init__(self):
+        self.acc = object()
+
+
+@pytest.fixture
+def isolated(monkeypatch):
+    """Run each test against only its own checks/pipelines, not the
+    module-level ones other imports registered."""
+    monkeypatch.setattr(watchdog, '_CHECKS', [])
+    monkeypatch.setattr(watchdog, '_PIPELINES',
+                        type(watchdog._PIPELINES)())
+
+
+def test_leak_reported_with_pipeline_forensics(isolated):
+    check = watchdog.LeakCheck('test resource(s) leaked',
+                               lambda s: s.acc is not None)
+    leaked = FakeScan()
+    check.track(leaked)
+
+    pipeline = Pipeline()
+    stage = pipeline.stage('json_parse')
+    stage.bump('ninputs', 42)
+    stage.bump('nfilteredout', 7)
+
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    text = out.getvalue()
+    assert 'premature exit (1 test resource(s) leaked)' in text
+    assert 'forensics' in text
+    # --counters dump format: name %-18s counter: %-13s value %8d
+    assert 'json_parse         ninputs:           42' in text
+    assert 'json_parse         nfilteredout:       7' in text
+
+
+def test_forensics_dumped_once_for_multiple_firing_checks(isolated):
+    c1 = watchdog.LeakCheck('scan(s) leaked', lambda s: True)
+    c2 = watchdog.LeakCheck('executor(s) leaked', lambda s: True)
+    a, b = FakeScan(), FakeScan()
+    c1.track(a)
+    c2.track(b)
+
+    pipeline = Pipeline()
+    pipeline.stage('find').bump('nregfiles', 9)
+
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    text = out.getvalue()
+    assert 'scan(s) leaked' in text
+    assert 'executor(s) leaked' in text
+    assert text.count('premature-exit forensics') == 1
+
+
+def test_hidden_and_zero_counters_produce_no_forensics_header(isolated):
+    check = watchdog.LeakCheck('x leaked', lambda s: True)
+    obj = FakeScan()
+    check.track(obj)
+
+    pipeline = Pipeline()
+    stage = pipeline.stage('scan')
+    stage.bump('nzero', 0)
+    stage.bump_hidden('ntelemetry', 5)
+
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    text = out.getvalue()
+    assert 'premature exit' in text
+    # nothing dumpable: the header must not print over an empty dump
+    assert 'forensics' not in text
+
+
+def test_no_leak_no_output(isolated):
+    check = watchdog.LeakCheck('x', lambda s: s.acc is not None)
+    obj = FakeScan()
+    check.track(obj)
+    obj.acc = None
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    assert out.getvalue() == ''
+
+
+def test_untracked_and_collected_objects_ignored(isolated):
+    check = watchdog.LeakCheck('x', lambda s: True)
+    a, b = FakeScan(), FakeScan()
+    check.track(a)
+    check.track(b)
+    check.untrack(a)
+    del b  # weakly tracked: collection removes it
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    assert out.getvalue() == ''
