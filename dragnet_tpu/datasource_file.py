@@ -525,6 +525,14 @@ class DatasourceFile(object):
             stage.bump('noutputs', int(alive0.sum()))
             return alive0
 
+        # stacked multi-metric device program: all metrics fold in ONE
+        # dispatch per batch with shared columns uploaded once (SURVEY
+        # §7.7); None when the scanners don't support it (host engine,
+        # mesh subclass, single metric) — then the per-scan loop runs
+        from . import device_scan as mod_device_scan
+        stack = mod_device_scan.make_stack(scanners) \
+            if scan_cls is not VectorScan else None
+
         nworkers = scan_mt.scan_threads()
         use_mt = nworkers > 0 and scan_cls is VectorScan
         # auto-device builds mirror the scan path: MT host workers by
@@ -596,8 +604,11 @@ class DatasourceFile(object):
                 if ds_pred is not None:
                     alive0 = eval_ds_filter(ds_pred, ds_stage,
                                             provider, n)
-                for s in scanners:
-                    s._process(provider, weights, alive=alive0)
+                if stack is not None:
+                    stack.process(provider, weights, alive0)
+                else:
+                    for s in scanners:
+                        s._process(provider, weights, alive=alive0)
                 parser.reset_batch()
                 if any(s._disabled for s in scanners):
                     # coordinated hand-back: all metric scanners leave
@@ -658,8 +669,11 @@ class DatasourceFile(object):
                 if ds_pred is not None:
                     alive0 = eval_ds_filter(ds_pred, ds_stage, provider,
                                             n)
-                for s in scanners:
-                    s._process(provider, weights, alive=alive0)
+                if stack is not None:
+                    stack.process(provider, weights, alive0)
+                else:
+                    for s in scanners:
+                        s._process(provider, weights, alive=alive0)
                 parser.reset_batch()
 
             self._stream_native(files, parser, flush, BATCH_SIZE,

@@ -95,6 +95,18 @@ def _rate_field(r):
 _PROGRAM_CACHE = {}
 _ACC_INIT_CACHE = {}
 
+import collections
+
+# run_scatter/run_pallas are jitted (args, acc) -> acc callables; fold
+# is the UNJITTED (args, acc, use_pallas) body DeviceScanStack composes
+# into one combined jit across metrics
+_Programs = collections.namedtuple(
+    '_Programs', 'run_scatter run_pallas acc_init fold have_pallas')
+
+# combined multi-metric programs (DeviceScanStack), keyed by the tuple
+# of member program keys + pallas flags
+_STACK_CACHE = {}
+
 
 def _pow2(x):
     p = 8
@@ -221,10 +233,25 @@ class DeviceScan(VectorScan):
     # forced mode owns the stream from the first batch)
     AUTO_STREAM = False
 
+    # whether DeviceScanStack may fuse this scan into a combined
+    # multi-metric program (the mesh subclass opts out: its shard_map
+    # spec derivation assumes unprefixed input names)
+    STACKABLE = True
+
     def __init__(self, query, time_field, pipeline, ds_filter=None):
         VectorScan.__init__(self, query, time_field, pipeline,
                             ds_filter=ds_filter)
         _SCAN_LEAKS.track(self)
+        # input-key namespace: '' standalone; DeviceScanStack assigns
+        # 'm<i>_' so per-scan inputs (leaf tables, translate tables,
+        # synth columns, base) coexist in one merged inputs dict while
+        # parser-derived columns stay shared across metrics
+        self._pfx = ''
+        # when True, _run_staged records (run, inputs, staged) on
+        # self.captured — the kernel-resident benchmark replays the
+        # exact production program over device-resident inputs
+        self.capture_next = False
+        self.captured = None
         self._records_seen = 0
         self._backend_ok = None
         self._host_records = 0
@@ -464,19 +491,37 @@ class DeviceScan(VectorScan):
             return False
         if self._backend_ok is None and not self._probe_backend():
             return False
+        inputs = {}
+        staged = self._stage_device(provider, weights, alive, inputs)
+        if staged is None:
+            return False
+        self._run_staged(staged, inputs)
+        return True
+
+    def _stage_device(self, provider, weights, alive, inputs):
+        """Eligibility checks + device-input assembly for one batch,
+        writing into the caller's `inputs` dict (shared across scans
+        under DeviceScanStack: parser-derived columns use unprefixed
+        keys so N metric scans upload them once; per-scan inputs carry
+        self._pfx).  Returns the staged execution parameters
+        (pn, profile, caps, ns, total_w) or None when this batch must
+        take the host path.  Commits plan-state (windows/caps) and
+        flushes on epoch flips as side effects — safe even if a sibling
+        scan later fails staging, since the host path computes the same
+        results regardless of plan state."""
         mn = provider.mn
         n = provider.n
+        pfx = self._pfx
 
         w = np.asarray(weights, dtype=np.float64)
         if len(w) != n or not np.all(np.isfinite(w)) or \
                 not np.all(w == np.floor(w)):
-            return False
+            return None
         total_w = float(np.abs(w).sum())
         if total_w >= 2 ** 31 or (len(w) and
                                   (w.min() < I32MIN or w.max() > I32MAX)):
-            return False
+            return None
 
-        inputs = {}
         # Upload profile: static per-program flags that let the body
         # synthesize constant inputs on device instead of uploading
         # them — the H2D bytes per record are the device path's cost
@@ -505,9 +550,23 @@ class DeviceScan(VectorScan):
         # audition, MT workers — lack them and take the numpy path)
         src = provider.parser
 
+        # per-batch memo on the SHARED provider: under DeviceScanStack
+        # N metric scans stage against one provider, and each parser
+        # accessor materializes a fresh array (ctypes copy) — fields
+        # read by several metrics must pay that once, not N times
+        memo = provider.__dict__.setdefault('_stage_memo', {})
+
+        def _memo1(kind, f, fn):
+            key = (kind, f)
+            v = memo.get(key)
+            if v is None:
+                v = fn(f)
+                memo[key] = v
+            return v
+
         def _stats(f):
             fn = getattr(src, 'field_stats', None)
-            return fn(f) if fn is not None else None
+            return _memo1('stats', f, fn) if fn is not None else None
 
         def _widen(table, key, has_str, has_num, all_num):
             cur = table.get(key)
@@ -552,19 +611,21 @@ class DeviceScan(VectorScan):
             if st is not None:
                 narr, i32ok, nmn_f, nmx_f, nnum, nstr = st
                 if narr:
-                    return False
+                    return None
                 if nnum and not i32ok:
-                    return False
+                    return None
                 has_str, has_num, all_num = _widen(
                     sk['filter'], f, nstr > 0, nnum > 0, nnum == n)
-                tags = src.tags_col(f) if not all_num else None
-                strcodes = src.strcodes_col(f) if has_str else None
-                iv = src.nums_i32(f) if has_num else None
+                tags = _memo1('tags', f, src.tags_col) \
+                    if not all_num else None
+                strcodes = _memo1('str', f, src.strcodes_col) \
+                    if has_str else None
+                iv = _memo1('num', f, src.nums_i32) if has_num else None
                 nrange = (int(nmn_f), int(nmx_f)) if nnum else (0, 0)
             else:
                 tags, nums, strcodes = provider._field(f)
                 if (tags == mn.TAG_ARRAY).any():
-                    return False
+                    return None
                 m = (tags == mn.TAG_INT) | (tags == mn.TAG_NUMBER)
                 obs_num = bool(m.any())
                 if obs_num:
@@ -572,7 +633,7 @@ class DeviceScan(VectorScan):
                     if not (np.all(np.isfinite(nm)) and
                             np.all(nm == np.floor(nm)) and
                             nm.min() >= I32MIN and nm.max() <= I32MAX):
-                        return False
+                        return None
                 has_str, has_num, all_num = _widen(
                     sk['filter'], f, bool((tags == mn.TAG_STRING)
                                           .any()), obs_num,
@@ -589,40 +650,59 @@ class DeviceScan(VectorScan):
             filter_profile.append((f, has_str, has_num, all_num))
             if not all_num:
                 inputs['tags_' + f] = tags.astype(np.uint8, copy=False)
-            if has_str:
+            if has_str and ('str_' + f) not in inputs:
                 # -1 marks non-string rows (masked on device; any
                 # index works), so the floor of the range is -1
                 dlen = len(src.dictionary(f))
                 inputs['str_' + f] = _narrow('str_' + f, strcodes,
                                              -1, dlen - 1)
-            if has_num:
+            if has_num and ('num_' + f) not in inputs:
                 inputs['num_' + f] = _narrow('num_' + f, iv, *nrange)
 
         # synthetic date fields: combined first-error + needed ts columns
         synth_vals = {}
+        use_dstats = False
         if self.synthetic:
             dstats_fn = getattr(src, 'date_stats', None)
-            first_ds = dstats_fn(self.synthetic[0]['field']) \
+            first_ds = _memo1('dstats', self.synthetic[0]['field'],
+                              dstats_fn) \
                 if dstats_fn is not None else None
             use_dstats = first_ds is not None
             errs = None
             if use_dstats:
+                # SHARED keys: under dstats the ts column is a pure
+                # function of its source field ('tsf_<field>') and the
+                # error chain of the ordered field list, so stacked
+                # sibling scans reading the same date fields reuse one
+                # upload instead of N prefixed copies
+                terr_key = 'terr_' + '|'.join(
+                    fc['field'] for fc in self.synthetic)
                 for i, fc in enumerate(self.synthetic):
                     all_i32, nok = first_ds if i == 0 \
-                        else dstats_fn(fc['field'])
+                        else _memo1('dstats', fc['field'], dstats_fn)
                     if nok and not all_i32:
-                        return False
-                    err = src.date_err(fc['field'])
-                    synth_vals[fc['name']] = src.date_i32(fc['field'])
-                    errs = err if errs is None else \
-                        np.where(errs == 0, err, errs)
+                        return None
+                    synth_vals[fc['name']] = _memo1(
+                        'date', fc['field'], src.date_i32)
+                errs = inputs.get(terr_key)
+                if errs is not None and len(errs) != n:
+                    # a sibling scan staged (and padded) it already;
+                    # host-side uses need the unpadded batch view
+                    errs = errs[:n]
+                if errs is None:
+                    for fc in self.synthetic:
+                        err = _memo1('derr', fc['field'], src.date_err)
+                        errs = err if errs is None else \
+                            np.where(errs == 0, err, errs)
             else:
+                terr_key = pfx + 'terr'
                 for fc in self.synthetic:
                     vals, err = provider.date_column(fc['field'])
                     synth_vals[fc['name']] = vals
                     errs = err if errs is None else \
                         np.where(errs == 0, err, errs)
             ok = errs == 0
+            sfield = {s['name']: s['field'] for s in self.synthetic}
             need = set()
             if self.time_bounds is not None:
                 need.add('dn_ts')
@@ -632,18 +712,21 @@ class DeviceScan(VectorScan):
             for name in need:
                 v = synth_vals[name]
                 if use_dstats:
-                    # already exact-i32 with error rows zeroed
-                    inputs['ts_' + name] = v
+                    # already exact-i32 with error rows zeroed (skip
+                    # when a sibling scan staged+padded it already)
+                    if ('tsf_' + sfield[name]) not in inputs:
+                        inputs['tsf_' + sfield[name]] = v
                     continue
                 vo = v[ok]
                 if len(vo) and not (np.all(np.isfinite(vo)) and
                                     np.all(vo == np.floor(vo)) and
                                     vo.min() >= I32MIN and
                                     vo.max() <= I32MAX):
-                    return False
-                inputs['ts_' + name] = np.where(ok, v, 0).astype(
+                    return None
+                inputs[pfx + 'ts_' + name] = np.where(ok, v, 0).astype(
                     np.int64).astype(np.int32)
-            inputs['terr'] = errs
+            if terr_key not in inputs:
+                inputs[terr_key] = errs
 
         # key columns: update windows/caps, assemble uploads
         new_caps = []
@@ -664,7 +747,7 @@ class DeviceScan(VectorScan):
                         provider.string_codes(p.name, p.column),
                         dtype=np.int64)
                     radix_now = len(p.column.dict.values)
-                    inputs['key_' + p.name] = _narrow(
+                    inputs[pfx + 'key_' + p.name] = _narrow(
                         'key_' + p.name, codes, 0,
                         max(radix_now - 1, 0))
                 else:
@@ -681,14 +764,15 @@ class DeviceScan(VectorScan):
                             else np.zeros(1, dtype=np.int32)
                         dev = jax.device_put(_pad_pow2(up))
                         self._trans_dev[p.name] = (len(trans), dev)
-                    inputs['trans_' + p.name] = \
+                    inputs[pfx + 'trans_' + p.name] = \
                         self._trans_dev[p.name][1]
                     if ('str_' + p.name) not in inputs:
                         # (a field that is both filter and breakdown
                         # reuses the filter loop's upload — one sticky
                         # key per physical input)
                         if strcodes is None:
-                            strcodes = src.strcodes_col(p.name)
+                            strcodes = _memo1('str', p.name,
+                                              src.strcodes_col)
                         dlen = len(provider.parser.dictionary(p.name))
                         inputs['str_' + p.name] = _narrow(
                             'str_' + p.name, strcodes, 0,
@@ -713,19 +797,22 @@ class DeviceScan(VectorScan):
                         # valid rows, and min/max come from the stats
                         narr, i32ok, nmn, nmx, nnum, _ = st
                         if nnum and not i32ok:
-                            return False
-                        inputs['kv_' + p.name] = _narrow(
-                            'kv_' + p.name, src.nums_i32(p.name),
-                            int(nmn) if nnum else 0,
-                            int(nmx) if nnum else 0)
+                            return None
+                        if ('kv_' + p.name) not in inputs:
+                            inputs['kv_' + p.name] = _narrow(
+                                'kv_' + p.name,
+                                _memo1('num', p.name, src.nums_i32),
+                                int(nmn) if nnum else 0,
+                                int(nmx) if nnum else 0)
                         kv_skip = sk['kvalid'].get(p.name, True) and \
                             nnum == n
                         sk['kvalid'][p.name] = kv_skip
                         if kv_skip:
                             # every row numeric: no validity upload
                             kvalid_profile.append(p.name)
-                        else:
-                            tags_k = src.tags_col(p.name)
+                        elif ('kvalid_' + p.name) not in inputs:
+                            tags_k = _memo1('tags', p.name,
+                                            src.tags_col)
                             inputs['kvalid_' + p.name] = \
                                 (tags_k == mn.TAG_INT) | \
                                 (tags_k == mn.TAG_NUMBER)
@@ -737,19 +824,21 @@ class DeviceScan(VectorScan):
                                             np.all(vv == np.floor(vv))
                                             and vv.min() >= I32MIN and
                                             vv.max() <= I32MAX):
-                            return False
-                        fill = int(vv[0]) if len(vv) else 0
-                        v = np.where(valid, vals, fill).astype(np.int64)
-                        inputs['kv_' + p.name] = _narrow(
-                            'kv_' + p.name, v.astype(np.int32),
-                            int(vv.min()) if len(vv) else 0,
-                            int(vv.max()) if len(vv) else 0)
+                            return None
+                        if ('kv_' + p.name) not in inputs:
+                            fill = int(vv[0]) if len(vv) else 0
+                            v = np.where(valid, vals,
+                                         fill).astype(np.int64)
+                            inputs['kv_' + p.name] = _narrow(
+                                'kv_' + p.name, v.astype(np.int32),
+                                int(vv.min()) if len(vv) else 0,
+                                int(vv.max()) if len(vv) else 0)
                         kv_skip = sk['kvalid'].get(p.name, True) and \
                             bool(valid.all())
                         sk['kvalid'][p.name] = kv_skip
                         if kv_skip:
                             kvalid_profile.append(p.name)
-                        else:
+                        elif ('kvalid_' + p.name) not in inputs:
                             inputs['kvalid_' + p.name] = valid
                         minmax = (int(vv.min()), int(vv.max())) \
                             if len(vv) else None
@@ -779,7 +868,7 @@ class DeviceScan(VectorScan):
             ns *= c
         if ns > MAX_DENSE_SEGMENTS:
             self._disabled = True
-            return False
+            return None
 
         # commit plan-state changes; epoch flip rebuilds the program
         for p, cap, lo, host, wset in pending:
@@ -802,7 +891,7 @@ class DeviceScan(VectorScan):
                     else np.zeros(1, dtype=np.int8)
                 dev = jax.device_put(_pad_pow2(up))
                 self._leaf_tables[i] = (len(table), dev)
-            inputs['tab_%d' % i] = self._leaf_tables[i][1]
+            inputs[pfx + 'tab_%d' % i] = self._leaf_tables[i][1]
             if i not in self._ctabs:
                 jax, jnp = get_jax()
                 ctab = np.zeros(16, dtype=np.int8)
@@ -812,7 +901,7 @@ class DeviceScan(VectorScan):
                 ctab[mn.TAG_TRUE] = leaf.outcome(True)
                 ctab[mn.TAG_OBJECT] = leaf.outcome({})
                 self._ctabs[i] = jax.device_put(ctab)
-            inputs['ctab_%d' % i] = self._ctabs[i]
+            inputs[pfx + 'ctab_%d' % i] = self._ctabs[i]
 
         # pad every per-record array to a stable capacity (batches can
         # overshoot BATCH_SIZE: the streamer only flushes between
@@ -836,28 +925,44 @@ class DeviceScan(VectorScan):
                 inputs['alive'][n:] = False
 
         profile = (w1, gen_alive, tuple(filter_profile),
-                   tuple(kvalid_profile))
-        pkey = (pn, profile)
-        progs = self._programs.get(pkey) if self._programs else None
-        if progs is None:
-            progs = self._build_programs(tuple(new_caps), pn, profile)
-            if self._programs is None:
-                self._programs = {}
-            self._programs[pkey] = progs
-        run_scatter, run_pallas, acc_init = progs
-        from .ops import pallas_kernels as pk
-        use_pallas = run_pallas is not None and \
-            pk.should_use(ns, total_w)
-        run = run_pallas if use_pallas else run_scatter
+                   tuple(kvalid_profile), use_dstats)
+        return (pn, profile, tuple(new_caps), ns, total_w)
+
+    def _ensure_acc(self, acc_init, caps, ns):
         if self._acc is None:
             self._acc = acc_init()
             self._acc_meta = {
-                'caps': tuple(new_caps),
+                'caps': tuple(caps),
                 'cols': [(p.kind, p.lo) for p in self._plans],
                 'ns': ns,
             }
             self._acc_batch = 0
-        inputs['base'] = np.int64(self._acc_batch << 32)
+
+    def _staged_programs(self, staged):
+        """(progs, use_pallas) for a staged batch — the program lookup
+        shared by the standalone path and DeviceScanStack."""
+        pn, profile, caps, ns, total_w = staged
+        pkey = (pn, profile)
+        progs = self._programs.get(pkey) if self._programs else None
+        if progs is None:
+            progs = self._build_programs(caps, pn, profile)
+            if self._programs is None:
+                self._programs = {}
+            self._programs[pkey] = progs
+        from .ops import pallas_kernels as pk
+        use_pallas = progs.run_pallas is not None and \
+            pk.should_use(ns, total_w)
+        return progs, use_pallas
+
+    def _run_staged(self, staged, inputs):
+        pn, profile, caps, ns, total_w = staged
+        progs, use_pallas = self._staged_programs(staged)
+        run = progs.run_pallas if use_pallas else progs.run_scatter
+        self._ensure_acc(progs.acc_init, caps, ns)
+        inputs[self._pfx + 'base'] = np.int64(self._acc_batch << 32)
+        if self.capture_next:
+            self.capture_next = False
+            self.captured = (run, dict(inputs), staged, use_pallas)
         self._acc = run(inputs, self._acc)
         self._acc_batch += 1
         if self._acc_batch % SYNC_EVERY_BATCHES == 0:
@@ -865,7 +970,6 @@ class DeviceScan(VectorScan):
             # host can race ahead of the device, and so how many padded
             # input buffers are pinned by in-flight executions
             self._sync_device()
-        return True
 
     # -- the device program -------------------------------------------------
 
@@ -889,10 +993,18 @@ class DeviceScan(VectorScan):
             jsv.json_stringify(self.user_pred.ast)
             if self.user_pred is not None else None,
             self.time_bounds,
-            tuple(sorted(s['name'] for s in self.synthetic)),
+            # ordered (name, field) pairs: the traced body bakes in
+            # field-derived input keys ('tsf_<field>') and an
+            # order-dependent error chain ('terr_<f1|f2>'), so neither
+            # the field mapping nor the order may collide in the cache
+            tuple((s['name'], s['field']) for s in self.synthetic),
             len(self._counter_spec),
             self._mesh_key(),
             profile,
+            # the traced body reads per-scan inputs under this prefix;
+            # two structurally-identical scans in a DeviceScanStack
+            # must not share a cached program
+            self._pfx,
         )
 
     # -- mesh hooks (no-ops on the single-device path; the cluster
@@ -930,7 +1042,8 @@ class DeviceScan(VectorScan):
         mn = mod_native
         from .ops import pallas_kernels as pk
 
-        w1, gen_alive, filter_profile, kvalid_skip = profile
+        w1, gen_alive, filter_profile, kvalid_skip, use_dstats = \
+            profile
         fprof = {f: (has_str, has_num, all_num)
                  for f, has_str, has_num, all_num in filter_profile}
         kvalid_skip = frozenset(kvalid_skip)
@@ -952,6 +1065,21 @@ class DeviceScan(VectorScan):
         # the whole first scan instance — aggregator, dictionaries and
         # device tables included — for the life of the process)
         leaf_fields = [leaf.field for _, leaf in self._leaf_list]
+        pfx = self._pfx
+        # ts/terr keys mirror _stage_device: shared field-keyed
+        # uploads under dstats, scan-private otherwise
+        sfield = {s['name']: s['field'] for s in self.synthetic}
+        if use_dstats:
+            terr_key = 'terr_' + '|'.join(
+                fc['field'] for fc in self.synthetic)
+
+            def ts_key(name):
+                return 'tsf_' + sfield[name]
+        else:
+            terr_key = pfx + 'terr'
+
+            def ts_key(name):
+                return pfx + 'ts_' + name
         num_plans = self._num_plans
         time_bounds = self.time_bounds
         has_synth = bool(self.synthetic)
@@ -1009,13 +1137,13 @@ class DeviceScan(VectorScan):
                 # every row numeric: tags/str uploads were skipped
                 return leaf_num_out(i, args, f)
             tags = args['tags_' + f]
-            out = args['ctab_%d' % i][tags]
+            out = args[pfx + 'ctab_%d' % i][tags]
             if has_str:
                 # gather indices must be i32: narrowed i16 codes
                 # overflow JAX's negative-index normalization once the
                 # pow2-padded table exceeds 32767 entries
                 out = jnp.where(tags == mn.TAG_STRING,
-                                args['tab_%d' % i][as_i32(
+                                args[pfx + 'tab_%d' % i][as_i32(
                                     args['str_' + f])],
                                 out)
             if not has_num:
@@ -1079,7 +1207,7 @@ class DeviceScan(VectorScan):
 
             if has_synth:
                 counters.append(isum(alive))
-                terr = args['terr']
+                terr = args[terr_key]
                 counters.append(isum(alive & (terr == 1)))   # UNDEF
                 counters.append(isum(alive & (terr == 2)))   # BADDATE
                 alive = alive & (terr == 0)
@@ -1087,7 +1215,7 @@ class DeviceScan(VectorScan):
 
             if time_bounds is not None:
                 counters.append(isum(alive))
-                ts = args['ts_dn_ts']
+                ts = args[ts_key('dn_ts')]
                 lo, hi = time_bounds
                 ok = jnp.ones((bn,), dtype=bool)
                 # Bounds are Python ints baked at trace time and may lie
@@ -1119,14 +1247,14 @@ class DeviceScan(VectorScan):
             for p in plans:
                 if p.kind == 'str':
                     if p.host_translate:
-                        codes.append(as_i32(args['key_' + p.name]))
+                        codes.append(as_i32(args[pfx + 'key_' + p.name]))
                     else:
                         codes.append(
-                            args['trans_' + p.name][as_i32(
+                            args[pfx + 'trans_' + p.name][as_i32(
                                 args['str_' + p.name])])
                     continue
                 if p.field.startswith('\0synth:'):
-                    v = args['ts_' + p.field[len('\0synth:'):]]
+                    v = args[ts_key(p.field[len('\0synth:'):])]
                 else:
                     if p.name not in kvalid_skip:
                         valid = args['kvalid_' + p.name]
@@ -1187,7 +1315,7 @@ class DeviceScan(VectorScan):
 
         per_record_keys = ('alive', 'weights', 'terr')
         per_record_prefixes = ('tags_', 'str_', 'num_', 'ts_', 'kv_',
-                               'kvalid_', 'key_')
+                               'kvalid_', 'key_', 'tsf_', 'terr_')
 
         def run_body(args, use_pallas):
             if mesh is None:
@@ -1195,7 +1323,7 @@ class DeviceScan(VectorScan):
             from jax.sharding import PartitionSpec as SP
             specs = {}
             for k in args:
-                if k == 'base':
+                if k == pfx + 'base':
                     continue
                 if k in per_record_keys or \
                         k.startswith(per_record_prefixes):
@@ -1218,7 +1346,7 @@ class DeviceScan(VectorScan):
             i64 = jnp.int64
             bfirst = jnp.where(
                 first < I32MAX,
-                args['base'] + first.astype(i64),
+                args[pfx + 'base'] + first.astype(i64),
                 i64(I64MAX))
             return (acc[0] + dense.astype(i64),
                     jnp.minimum(acc[1], bfirst),
@@ -1226,7 +1354,8 @@ class DeviceScan(VectorScan):
 
         run_scatter = jax.jit(lambda args, acc: fold(args, acc, False))
         run_pallas = None
-        if pk.pallas_ok(ns) and pk.available():
+        have_pallas = pk.pallas_ok(ns) and pk.available()
+        if have_pallas:
             run_pallas = jax.jit(lambda args, acc: fold(args, acc, True))
 
         init_key = (acc_ns, ncnt)
@@ -1242,9 +1371,21 @@ class DeviceScan(VectorScan):
             if len(_ACC_INIT_CACHE) >= 64:
                 _ACC_INIT_CACHE.pop(next(iter(_ACC_INIT_CACHE)))
             _ACC_INIT_CACHE[init_key] = acc_init
-        return run_scatter, run_pallas, acc_init
+        return _Programs(run_scatter, run_pallas, acc_init, fold,
+                         have_pallas)
 
     # -- flush: fetch + ordered merge ---------------------------------------
+
+    # accumulators at least this large are compacted ON DEVICE before
+    # the fetch (argsort by first-occurrence, gather occurred segments)
+    # — the device->host direction is the tunnel's weak side (~14 MB/s
+    # measured vs ~1.2 GB/s host->device on this rig), so fetching a
+    # multi-MB dense array when a few thousand tuples occurred is where
+    # forced-device scans and builds actually lost to the host
+    COMPACT_MIN_SEGMENTS = 16384
+    # speculative compacted-fetch width: one round trip when the
+    # occurred count fits (the norm); a larger refetch otherwise
+    COMPACT_K = 1 << 16
 
     def _flush(self):
         """Fetch the device accumulator (one round trip for the whole
@@ -1263,15 +1404,25 @@ class DeviceScan(VectorScan):
         # kept out of the --counters dump for golden byte parity)
         if nbatches:
             self.aggr.stage.bump_hidden('ndevicebatches', nbatches)
-        for a in acc:
-            if hasattr(a, 'copy_to_host_async'):
-                try:
-                    a.copy_to_host_async()
-                except Exception:
-                    pass
-        dense = np.asarray(acc[0])
-        first = np.asarray(acc[1])
-        cvec = np.asarray(acc[2])
+
+        segs = wsum = None
+        if meta['cols'] and meta['ns'] >= self.COMPACT_MIN_SEGMENTS:
+            fetched = _compact_fetch(acc, meta['ns'], self.COMPACT_K)
+            if fetched is not None:
+                segs, wsum, cvec = fetched
+                self.aggr.stage.bump_hidden('ncompactflush', 1)
+
+        if segs is None:
+            for a in acc:
+                if hasattr(a, 'copy_to_host_async'):
+                    try:
+                        a.copy_to_host_async()
+                    except Exception:
+                        pass
+            dense = np.asarray(acc[0])
+            first = np.asarray(acc[1])
+            cvec = np.asarray(acc[2])
+
         for (stage, name, always), v in zip(self._counter_spec, cvec):
             v = int(v)
             if always or v:
@@ -1279,11 +1430,15 @@ class DeviceScan(VectorScan):
         if not meta['cols']:
             self.aggr.write_key((), self._weight(float(dense[0])))
             return
-        occurred = np.nonzero(first < I64MAX)[0]
-        if len(occurred) == 0:
+        if segs is None:
+            occurred = np.nonzero(first < I64MAX)[0]
+            if len(occurred) == 0:
+                return
+            order = np.argsort(first[occurred], kind='stable')
+            segs = occurred[order]
+            wsum = dense[segs].astype(np.float64)
+        elif len(segs) == 0:
             return
-        order = np.argsort(first[occurred], kind='stable')
-        segs = occurred[order]
         rem = segs.copy()
         caps = meta['caps']
         col_codes = [None] * len(caps)
@@ -1299,7 +1454,209 @@ class DeviceScan(VectorScan):
                 gcols.append(np.asarray(cc, dtype=np.int64))
             else:
                 gcols.append(np.asarray(cc, dtype=np.int64) + lo)
-        self._emit_unique(gcols, dense[segs].astype(np.float64))
+        self._emit_unique(gcols, wsum)
+
+
+# jitted flush-compaction programs, keyed by (acc_len, K)
+_COMPACT_CACHE = {}
+
+
+def _compact_program(acc_len, k):
+    key = (acc_len, k)
+    prog = _COMPACT_CACHE.get(key)
+    if prog is not None:
+        return prog
+    jax, jnp = get_jax()
+
+    def compact(acc):
+        dense, first, cvec = acc
+        cnt = jnp.sum(first < I64MAX).astype(jnp.int32)
+        # ascending argsort puts occurred segments first, in exact
+        # first-occurrence order (firsts are distinct: each global row
+        # index belongs to one segment); I64MAX sentinels sort last
+        order = jnp.argsort(first)[:k]
+        occ = first[order] < I64MAX
+        segs = jnp.where(occ, order.astype(jnp.int32), jnp.int32(-1))
+        return cnt, segs, dense[order], cvec
+
+    prog = jax.jit(compact)
+    if len(_COMPACT_CACHE) >= 64:
+        _COMPACT_CACHE.pop(next(iter(_COMPACT_CACHE)))
+    _COMPACT_CACHE[key] = prog
+    return prog
+
+
+def _compact_fetch(acc, ns, k0):
+    """Device-side compaction of a flush fetch: returns
+    (segs i64[cnt] in first-occurrence order, weights f64[cnt], cvec)
+    fetching O(occurred) bytes instead of O(ns), or None to take the
+    full-fetch path.  One extra round trip only when more than k0
+    segments occurred (then a pow2-sized refetch)."""
+    acc_len = int(acc[0].shape[0])
+    k = min(acc_len, k0)
+    try:
+        while True:
+            cnt, segs, dense, cvec = _compact_program(acc_len, k)(acc)
+            for a in (cnt, segs, dense, cvec):
+                if hasattr(a, 'copy_to_host_async'):
+                    try:
+                        a.copy_to_host_async()
+                    except Exception:
+                        pass
+            n = int(np.asarray(cnt))
+            if n <= k:
+                segs = np.asarray(segs)[:n].astype(np.int64)
+                wsum = np.asarray(dense)[:n].astype(np.float64)
+                return segs, wsum, np.asarray(cvec)
+            k = min(acc_len, _pow2(n))
+    except Exception:
+        LOG.debug('compact fetch failed; full fetch')
+        return None
+
+
+class DeviceScanStack(object):
+    """One device program per batch for an N-metric build.
+
+    The reference's build fed one parse stream into N per-metric
+    scanners (lib/datasource-file.js:403-427); the round-4 device build
+    kept that shape — N separate DeviceScan programs per batch, each
+    re-uploading the columns it needs.  This stack fuses them: every
+    scan stages its inputs into ONE merged dict (parser-derived columns
+    use shared keys, so a column read by several metrics crosses H2D
+    once; per-scan inputs carry an 'm<i>_' prefix), and one combined
+    jit folds the batch into every metric's device-resident accumulator
+    in a single dispatch.  XLA sees all N pipelines in one module and
+    CSEs the shared subcomputations (gathers on shared columns, date
+    masks).  Builds amortize transfer over N metrics — the regime where
+    the chip beats the host even through a slow transport (SURVEY §7.7:
+    one pass, stacked metric programs).
+
+    Scans keep their own accumulators/flush/emission; the stack only
+    changes how batches are staged and dispatched, so per-scan results
+    (and the index artifacts) are byte-identical to the unstacked
+    path."""
+
+    def __init__(self, scans):
+        self.scans = list(scans)
+        # shared sticky upload-profile state: widening decisions apply
+        # to the shared physical inputs, so all scans must agree
+        shared = {'w1': True, 'gen_alive': True, 'filter': {},
+                  'kvalid': {}, 'dtypes': {}}
+        for i, s in enumerate(self.scans):
+            assert getattr(s, 'STACKABLE', False)
+            s._pfx = 'm%d_' % i
+            s._sticky = shared
+        self._nbatch = 0
+        # (scan_idx, pn, profile) -> full program key: _program_key
+        # json-stringifies predicate ASTs, too costly per batch
+        self._pkey_memo = {}
+
+    def process(self, provider, weights, alive):
+        """Process one batch for every scan: the combined device
+        program when every scan stages successfully, else the per-scan
+        paths (each of which may still use its own device program or
+        the host engine).  Exactly one of these runs per batch, so
+        insertion order and results match the unstacked path."""
+        n = provider.n
+        for s in self.scans:
+            if s._t0 is None:
+                s._t0 = time.monotonic()
+        if self._device_eligible(provider, n) and \
+                self._process_device(provider, weights, alive):
+            for s in self.scans:
+                s._records_seen += n
+                s._after_device_batch(n)
+            return
+        for s in self.scans:
+            s._process(provider, weights, alive=alive)
+
+    def _device_eligible(self, provider, n):
+        if not isinstance(provider, NativeColumns):
+            return False
+        for s in self.scans:
+            # mirror DeviceScan._process's escalation compare, which
+            # tests records_seen AFTER counting this batch
+            s._records_seen += n
+            try:
+                ok = (not s._disabled and
+                      s._records_seen > s.ESCALATE_RECORDS and
+                      s._engage_device())
+            finally:
+                s._records_seen -= n
+            if not ok:
+                return False
+        return True
+
+    def _process_device(self, provider, weights, alive):
+        scans = self.scans
+        inputs = {}
+        staged = []
+        for s in scans:
+            st = s._stage_device(provider, weights, alive, inputs)
+            if st is None:
+                return False
+            staged.append(st)
+        pns = set(st[0] for st in staged)
+        assert len(pns) == 1, pns    # same batch, same mesh => same pad
+
+        parts = []
+        key_parts = []
+        for i, (s, st) in enumerate(zip(scans, staged)):
+            pn, profile, caps, ns, total_w = st
+            progs, use_pallas = s._staged_programs(st)
+            s._ensure_acc(progs.acc_init, caps, ns)
+            inputs[s._pfx + 'base'] = np.int64(s._acc_batch << 32)
+            parts.append((progs.fold, use_pallas))
+            # epoch sig covers window origins/host_translate, which
+            # can change while caps stay the same
+            mkey = (i, pn, profile, s._epoch_sig)
+            pkey = self._pkey_memo.get(mkey)
+            if pkey is None:
+                pkey = s._program_key(caps, pn, profile)
+                self._pkey_memo[mkey] = pkey
+            key_parts.append((pkey, use_pallas))
+
+        # combined programs cache globally (like _PROGRAM_CACHE): every
+        # `dn build` constructs a fresh stack, and re-tracing the
+        # N-metric program per build costs seconds
+        ckey = tuple(key_parts)
+        run = _STACK_CACHE.get(ckey)
+        if run is None:
+            jax, _ = get_jax()
+            folds = [p[0] for p in parts]
+            ups = [p[1] for p in parts]
+
+            def stacked(args, accs):
+                return tuple(f(args, a, u)
+                             for f, a, u in zip(folds, accs, ups))
+            run = jax.jit(stacked)
+            if len(_STACK_CACHE) >= 32:
+                _STACK_CACHE.pop(next(iter(_STACK_CACHE)))
+            _STACK_CACHE[ckey] = run
+
+        accs = run(inputs, tuple(s._acc for s in scans))
+        for s, acc in zip(scans, accs):
+            s._acc = acc
+            s._acc_batch += 1
+            # telemetry: this batch went through the combined program
+            # (kept out of --counters for golden byte parity)
+            s.aggr.stage.bump_hidden('nstackedbatches', 1)
+        self._nbatch += 1
+        if self._nbatch % SYNC_EVERY_BATCHES == 0:
+            scans[0]._sync_device()
+        return True
+
+
+def make_stack(scanners):
+    """A DeviceScanStack when the scanner set supports it (>=2 device
+    scans outside a mesh), else None (callers keep the per-scan
+    loop)."""
+    if len(scanners) < 2:
+        return None
+    if not all(isinstance(s, DeviceScan) and
+               getattr(s, 'STACKABLE', False) for s in scanners):
+        return None
+    return DeviceScanStack(scanners)
 
 
 class _ShadowProbe(object):

@@ -1,0 +1,169 @@
+"""Stacked multi-metric device build (DeviceScanStack): N metrics fold
+through ONE combined device program per batch, and the index artifacts
+must be BYTE-identical to the host engine's — the same differential
+discipline as the scan path (the reference fed one parse stream into N
+per-metric scanners, lib/datasource-file.js:403-427)."""
+
+import json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import native as mod_native      # noqa: E402
+from dragnet_tpu import query as mod_query        # noqa: E402
+from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
+from dragnet_tpu.ops import get_jax, backend_ready  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    mod_native.get_lib() is None or get_jax() is None or
+    not backend_ready(),
+    reason='native parser or jax unavailable')
+
+
+METRICS = [
+    # shared columns across metrics: time (all), host (2), latency (2)
+    {'name': 'byhost', 'breakdowns': [
+        {'name': 'timestamp', 'field': 'time', 'date': '',
+         'aggr': 'lquantize', 'step': 86400},
+        {'name': 'host', 'field': 'host'}]},
+    {'name': 'bymethod', 'breakdowns': [
+        {'name': 'timestamp', 'field': 'time', 'date': '',
+         'aggr': 'lquantize', 'step': 86400},
+        {'name': 'method', 'field': 'req.method'},
+        {'name': 'latency', 'field': 'latency', 'aggr': 'quantize'}],
+     'filter': {'ne': ['host', 'b']}},
+    {'name': 'bylat', 'breakdowns': [
+        {'name': 'timestamp', 'field': 'time', 'date': '',
+         'aggr': 'lquantize', 'step': 86400},
+        {'name': 'host', 'field': 'host'},
+        {'name': 'latency', 'field': 'latency', 'aggr': 'lquantize',
+         'step': 50}]},
+]
+
+
+def _write_data(path, n, with_edges=False):
+    rng = random.Random(7)
+    lines = []
+    for i in range(n):
+        day = 1 + (i * 3 // n)
+        lines.append(json.dumps({
+            'time': '2014-05-%02dT%02d:%02d:%02dZ' % (
+                day, rng.randrange(24), rng.randrange(60),
+                rng.randrange(60)),
+            'host': rng.choice(['a', 'b', 'c', 'host-%d'
+                                % rng.randrange(20)]),
+            'req': {'method': rng.choice(['GET', 'PUT', 'DELETE'])},
+            'latency': rng.choice([0, 1, 3, 17, 200, 4096]),
+        }))
+    if with_edges:
+        # array-valued key field and non-integral latency force
+        # per-batch staging failures mid-stream
+        lines.insert(n // 3, json.dumps({
+            'time': '2014-05-01T05:00:00Z', 'host': [1, 'two'],
+            'req': {'method': 'GET'}, 'latency': 3}))
+        lines.insert(2 * n // 3, json.dumps({
+            'time': '2014-05-02T05:00:00Z', 'host': 'a',
+            'req': {'method': 'PUT'}, 'latency': 2.5}))
+    with open(path, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+
+
+def _ds(datafile, indexdir):
+    return DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': str(datafile),
+                              'indexPath': str(indexdir),
+                              'timeField': 'time'},
+        'ds_filter': None, 'ds_format': 'json',
+    })
+
+
+def _tree_bytes(root):
+    out = {}
+    for dirpath, dirs, files in os.walk(root):
+        for fn in sorted(files):
+            p = os.path.join(dirpath, fn)
+            with open(p, 'rb') as f:
+                out[os.path.relpath(p, root)] = f.read()
+    return out
+
+
+def _metrics():
+    return [mod_query.metric_deserialize(m) for m in METRICS]
+
+
+def _build(monkeypatch, datafile, indexdir, engine, batch=None):
+    monkeypatch.setenv('DN_ENGINE', engine)
+    monkeypatch.setenv('DN_PARSE_THREADS', '1')
+    if batch is not None:
+        from dragnet_tpu import engine as mod_engine
+        from dragnet_tpu import device_scan as mod_ds
+        monkeypatch.setattr(mod_engine, 'BATCH_SIZE', batch)
+        monkeypatch.setattr(mod_ds, 'BATCH_SIZE', batch)
+        monkeypatch.setenv('DN_READ_SIZE', str(batch * 64))
+    result = _ds(datafile, indexdir).build(_metrics(), 'day')
+    stacked = 0
+    for stage in result.pipeline.stages:
+        stacked += stage.counters.get('nstackedbatches', 0)
+    return result, stacked
+
+
+def test_stacked_build_byte_identical(tmp_path, monkeypatch):
+    datafile = tmp_path / 'data.log'
+    _write_data(datafile, 3000)
+
+    _, s_host = _build(monkeypatch, datafile, tmp_path / 'ih', 'vector')
+    assert s_host == 0
+    _, s_dev = _build(monkeypatch, datafile, tmp_path / 'id', 'jax')
+    assert s_dev > 0, 'combined device program never engaged'
+
+    host_tree = _tree_bytes(tmp_path / 'ih')
+    dev_tree = _tree_bytes(tmp_path / 'id')
+    assert host_tree.keys() == dev_tree.keys()
+    assert len(host_tree) == 3    # three daily shards
+    for rel in host_tree:
+        assert host_tree[rel] == dev_tree[rel], \
+            'index shard %s differs between stacked-device and host ' \
+            'builds' % rel
+
+
+def test_stacked_build_with_fallback_batches(tmp_path, monkeypatch):
+    """Batches a metric cannot stage (array key values, non-integral
+    quantize values) drop the whole batch to the per-scan paths;
+    results must still match the host build byte-for-byte."""
+    datafile = tmp_path / 'data.log'
+    _write_data(datafile, 1500, with_edges=True)
+
+    _, _ = _build(monkeypatch, datafile, tmp_path / 'ih', 'vector')
+    # small batches so the edge lines land in their own mid-stream
+    # batches (several staging transitions)
+    _, s_dev = _build(monkeypatch, datafile, tmp_path / 'id', 'jax',
+                      batch=128)
+    assert s_dev > 0
+
+    host_tree = _tree_bytes(tmp_path / 'ih')
+    dev_tree = _tree_bytes(tmp_path / 'id')
+    assert host_tree.keys() == dev_tree.keys()
+    for rel in host_tree:
+        assert host_tree[rel] == dev_tree[rel], rel
+
+
+def test_stacked_index_scan_points_identical(tmp_path, monkeypatch):
+    """index-scan (tagged points, insertion order) through the stack
+    equals the host engine's exactly."""
+    datafile = tmp_path / 'data.log'
+    _write_data(datafile, 2000)
+
+    monkeypatch.setenv('DN_PARSE_THREADS', '1')
+    monkeypatch.setenv('DN_ENGINE', 'vector')
+    host = _ds(datafile, tmp_path / 'ih').index_scan(_metrics(), 'day')
+    monkeypatch.setenv('DN_ENGINE', 'jax')
+    dev = _ds(datafile, tmp_path / 'id').index_scan(_metrics(), 'day')
+
+    assert [(f, v) for f, v in host.points] == \
+        [(f, v) for f, v in dev.points]

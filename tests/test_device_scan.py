@@ -287,3 +287,39 @@ def test_large_dictionary_i16_gather(monkeypatch, tmp_path):
     host2 = scan('host', q2)
     dev2 = scan('jax', q2)
     assert dev2 == host2
+
+
+@pytest.mark.parametrize('k0', [1 << 16, 4])
+def test_compact_flush_differential(tmp_path, monkeypatch, k0):
+    """Device-side flush compaction (argsort + gather of occurred
+    segments, fetching O(occurred) instead of O(ns)): forced to engage
+    via a tiny threshold, results and counters must still equal the
+    host engine exactly.  k0=4 forces the over-capacity refetch loop
+    (more occurred tuples than the speculative fetch width)."""
+    from dragnet_tpu import device_scan as mod_ds
+    monkeypatch.setattr(mod_ds.DeviceScan, 'COMPACT_MIN_SEGMENTS', 1)
+    monkeypatch.setattr(mod_ds.DeviceScan, 'COMPACT_K', k0)
+
+    rng = random.Random(41)
+    lines = _mklines(rng, 600)
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    qconf = {'breakdowns': [{'name': 'host'},
+                            {'name': 'latency', 'aggr': 'quantize'}]}
+    host_points, host_counters = _scan(monkeypatch, datafile, qconf,
+                                       engine='auto')
+
+    compacted = []
+    orig = mod_ds._compact_fetch
+
+    def spy(acc, ns, k):
+        r = orig(acc, ns, k)
+        compacted.append(r is not None)
+        return r
+    monkeypatch.setattr(mod_ds, '_compact_fetch', spy)
+    dev_points, dev_counters = _scan(monkeypatch, datafile, qconf,
+                                     engine='jax', batch=128)
+    assert host_points == dev_points
+    assert host_counters == dev_counters
+    assert compacted and all(compacted), 'compact fetch never engaged'
