@@ -1,22 +1,37 @@
 #!/usr/bin/env python3
-"""Benchmark harness: records/sec through `dn scan` on muskie-style JSON.
+"""Benchmark harness: records/sec through `dn scan`/`dn build` on
+muskie-style JSON, plus chip-level truth (kernel-resident throughput,
+transport bandwidth, MFU).
 
-Measures the BASELINE.json config "multi-field group-by over synthetic
-mktestdata records" end-to-end (newline-JSON parse -> filter -> bucketize
--> group-by), on the default engine (vectorized; jax/TPU kernels engage
-for large batches).
+Legs (all best-of-N with min/median recorded per metric — single-number
+round-over-round tracking was VERDICT r4 weak #7):
 
-vs_baseline is the speedup over the per-record host pipeline measured in
-the same run — the architectural stand-in for the reference's
-stream-per-record execution model (the reference publishes no numbers of
-its own; see BASELINE.md).
+* headline: 2M-record multi-field group-by scan, auto engine — the
+  configuration where the engine router (host MT / device) actually has
+  a decision to make.  The 300k leg r1-r4 used as the headline is kept
+  in extra for comparability.
+* large-scan trio: vectorized host, forced device, auto at 2M records.
+* high-cardinality: req.url x latency at 2M records (~410k output
+  tuples), host vs forced-device — the device runs the resident sparse
+  sort-merge program (the reference's OOM regime, README.md:668-681).
+* build trio: default/auto, host, forced-device (stacked multi-metric
+  program) at 2M records x 3 metrics.
+* many-shard index query: 365 daily shards, p50/p95 full-tree and
+  30-day-window queries, concurrency-10 fan-in vs sequential.
+* kernel-resident device microbenchmark (dragnet_tpu/devbench.py):
+  the production scan program over device-resident inputs — chip
+  rec/s, HBM GB/s, H2D/D2H bandwidth, and MFU for the pallas
+  aggregation — separating transport cost from chip capability.
+* DN_BENCH_SCALE=1 adds a 10M-record scan+build leg in a subprocess
+  with peak-RSS accounting and a budget gate.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 """
 
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -24,7 +39,6 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from dragnet_tpu import query as mod_query
 from dragnet_tpu.scan import StreamScan
-from dragnet_tpu.engine import VectorScan, BATCH_SIZE
 from dragnet_tpu.vpipe import Pipeline
 
 QUERY = {
@@ -36,6 +50,35 @@ QUERY = {
     ],
     'filter': {'ne': ['res.statusCode', 599]},
 }
+
+HC_QUERY = {'breakdowns': [{'name': 'req.url'}, {'name': 'latency'}]}
+
+# small accumulator (16 x 32 segments): the one-hot MXU kernel's home
+# turf, used for the MFU measurement
+PALLAS_QUERY = {'breakdowns': [{'name': 'host'},
+                               {'name': 'latency', 'aggr': 'quantize'}]}
+
+METRICS = [
+    {'name': 'm1', 'breakdowns': [
+        {'name': 'timestamp', 'field': 'time', 'date': '',
+         'aggr': 'lquantize', 'step': 86400},
+        {'name': 'host', 'field': 'host'},
+        {'name': 'req.method', 'field': 'req.method'},
+        {'name': 'operation', 'field': 'operation'},
+        {'name': 'latency', 'field': 'latency', 'aggr': 'quantize'}]},
+    {'name': 'm2', 'breakdowns': [
+        {'name': 'timestamp', 'field': 'time', 'date': '',
+         'aggr': 'lquantize', 'step': 86400},
+        {'name': 'host', 'field': 'host'},
+        {'name': 'res.statusCode', 'field': 'res.statusCode'}]},
+    {'name': 'm3', 'breakdowns': [
+        {'name': 'timestamp', 'field': 'time', 'date': '',
+         'aggr': 'lquantize', 'step': 86400},
+        {'name': 'operation', 'field': 'operation'},
+        {'name': 'latency', 'field': 'latency', 'aggr': 'lquantize',
+         'step': 100}],
+     'filter': {'ne': ['res.statusCode', 500]}},
+]
 
 
 def _mktestdata():
@@ -98,30 +141,21 @@ def gen_to_file(n, path, mindate_ms=None, maxdate_ms=None):
                     separators=(',', ':')).encode() + b'\n')
 
 
+def make_ds(datafile, indexdir=None):
+    from dragnet_tpu.datasource_file import DatasourceFile
+    bc = {'path': datafile}
+    if indexdir is not None:
+        bc['indexPath'] = indexdir
+        bc['timeField'] = 'time'
+    return DatasourceFile({
+        'ds_backend': 'file', 'ds_backend_config': bc,
+        'ds_filter': None, 'ds_format': 'json',
+    })
+
+
 def run_scan(datafile, query):
     """The real `dn scan` execution path (find -> ingest -> engine)."""
-    from dragnet_tpu.datasource_file import DatasourceFile
-    ds = DatasourceFile({
-        'ds_backend': 'file',
-        'ds_backend_config': {'path': datafile},
-        'ds_filter': None,
-        'ds_format': 'json',
-    })
-    return ds.scan(query)
-
-
-def run_vector(lines, query):
-    pipeline = Pipeline()
-    s = VectorScan(query, None, pipeline)
-    buf = []
-    for line in lines:
-        buf.append(json.loads(line))
-        if len(buf) >= BATCH_SIZE:
-            s.write_batch(buf, [1] * len(buf))
-            buf = []
-    if buf:
-        s.write_batch(buf, [1] * len(buf))
-    return s.aggr
+    return make_ds(datafile).scan(query)
 
 
 def run_host(lines, query):
@@ -132,185 +166,363 @@ def run_host(lines, query):
     return s.aggr
 
 
-def run_build_query(datafile, nrecords):
-    """Secondary metrics: `dn build` throughput (index construction,
-    BASELINE.json's second config) and index-query p50 latency over the
-    built daily indexes."""
-    import shutil
-    from dragnet_tpu.datasource_file import DatasourceFile
+class Runs(object):
+    """Per-metric repeat collection: best/median/all recorded so
+    round-over-round drift is attributable to noise or real change."""
 
-    idx = datafile + '.idx'
-    ds = DatasourceFile({
-        'ds_backend': 'file',
-        'ds_backend_config': {'path': datafile, 'indexPath': idx,
-                              'timeField': 'time'},
-        'ds_filter': None,
-        'ds_format': 'json',
-    })
-    metric = mod_query.metric_deserialize({'name': 'm', 'breakdowns': [
-        {'name': 'timestamp', 'field': 'time', 'date': '',
-         'aggr': 'lquantize', 'step': 86400},
-        {'name': 'host', 'field': 'host'},
-        {'name': 'req.method', 'field': 'req.method'},
-        {'name': 'operation', 'field': 'operation'},
-        {'name': 'latency', 'field': 'latency', 'aggr': 'quantize'}]})
-    t0 = time.time()
-    ds.build([metric], 'day')
-    build_s = time.time() - t0
+    def __init__(self):
+        self.all = {}
 
-    qq = mod_query.query_load({
-        'breakdowns': [{'name': 'host'},
-                       {'name': 'latency', 'aggr': 'quantize'}],
-        'filter': {'eq': ['req.method', 'GET']}})
-    times = []
-    for _ in range(15):
-        t0 = time.time()
-        ds.query(qq, 'day')
-        times.append(time.time() - t0)
-    times.sort()
-    shutil.rmtree(idx, ignore_errors=True)
-    return nrecords / build_s, times[len(times) // 2]
+    def add(self, name, value):
+        self.all.setdefault(name, []).append(value)
+
+    def best(self, name):
+        return max(self.all[name])
+
+    def summary(self):
+        out = {}
+        for name, vals in self.all.items():
+            out[name] = {
+                'best': round(max(vals)),
+                'median': round(statistics.median(vals)),
+                'all': [round(v) for v in vals],
+            }
+        return out
 
 
-def _timed_scan(datafile, nrecords, engine, repeats=3):
-    """Engine-pinned scan over datafile; best-of-N records/sec (the
-    same noise policy for every engine, so the side-by-side numbers in
-    BENCH_r*.json stay comparable)."""
-    prior = os.environ.get('DN_ENGINE')
+def _engine_env(engine):
     if engine is None:
         os.environ.pop('DN_ENGINE', None)
     else:
         os.environ['DN_ENGINE'] = engine
+
+
+def timed_scan(runs, name, datafile, nrecords, qconf, engine,
+               repeats=3):
+    """Engine-pinned scan; records every repeat's records/s.  Returns
+    (best_rps, npoints, ndevicebatches_of_best_run)."""
+    prior = os.environ.get('DN_ENGINE')
+    _engine_env(engine)
     try:
-        best = float('inf')
+        best = None
         for _ in range(repeats):
-            t0 = time.time()
-            result = run_scan(datafile, mod_query.query_load(QUERY))
-            best = min(best, time.time() - t0)
+            t0 = time.monotonic()
+            result = run_scan(datafile,
+                              mod_query.query_load(dict(qconf)))
+            dt = time.monotonic() - t0
+            runs.add(name, nrecords / dt)
+            if best is None or dt < best[0]:
+                ndev = sum(s.counters.get('ndevicebatches', 0)
+                           for s in result.pipeline.stages)
+                best = (dt, len(result.points), ndev)
     finally:
-        if prior is None:
-            os.environ.pop('DN_ENGINE', None)
-        else:
-            os.environ['DN_ENGINE'] = prior
-    # engine telemetry: did the device program actually fold batches,
-    # or did the scan silently fall back to the host path (no usable
-    # backend)?  Recording a fallback as a 'device' number would
-    # corrupt round-over-round regression tracking.
-    ndev = 0
-    for stage in result.pipeline.stages:
-        if stage.name == 'Aggregator':
-            ndev = stage.counters.get('ndevicebatches', 0)
-    return nrecords / best, len(result.points), ndev
+        _engine_env(prior)
+    return nrecords / best[0], best[1], best[2]
+
+
+def timed_build(runs, name, datafile, nrecords, engine, repeats=2):
+    import shutil
+    prior = os.environ.get('DN_ENGINE')
+    _engine_env(engine)
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    idx = datafile + '.idx.' + (engine or 'auto')
+    try:
+        best = None
+        for _ in range(repeats):
+            shutil.rmtree(idx, ignore_errors=True)
+            t0 = time.monotonic()
+            result = make_ds(datafile, idx).build(metrics, 'day')
+            dt = time.monotonic() - t0
+            runs.add(name, nrecords / dt)
+            if best is None or dt < best[0]:
+                stacked = sum(
+                    s.counters.get('nstackedbatches', 0)
+                    for s in result.pipeline.stages)
+                best = (dt, stacked)
+    finally:
+        _engine_env(prior)
+        shutil.rmtree(idx, ignore_errors=True)
+    return nrecords / best[0], best[1]
+
+
+def index_query_bench(tmpdir):
+    """Many-shard index tree: 365 daily shards (the shape the
+    reference's per-file fan-in was built for,
+    lib/datasource-file.js:629-689).  p50/p95 for full-tree and
+    30-day-window queries; concurrency-10 fan-in vs sequential."""
+    import shutil
+    datafile = os.path.join(tmpdir, 'year.log')
+    idx = os.path.join(tmpdir, 'year.idx')
+    n = 1000000
+    # one year of timestamps -> 365-366 daily shards
+    start_ms = 1388534400000             # 2014-01-01
+    end_ms = start_ms + 365 * 86400000
+    gen_to_file(n, datafile, mindate_ms=start_ms, maxdate_ms=end_ms)
+    ds = make_ds(datafile, idx)
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    t0 = time.monotonic()
+    ds.build(metrics, 'day')
+    build_s = time.monotonic() - t0
+    nshards = 0
+    for root, dirs, files in os.walk(idx):
+        nshards += len(files)
+
+    def q(after=None, before=None):
+        conf = {'breakdowns': [{'name': 'host'},
+                               {'name': 'latency', 'aggr': 'quantize'}],
+                'filter': {'eq': ['req.method', 'GET']}}
+        if after:
+            conf['timeAfter'] = after
+            conf['timeBefore'] = before
+        return mod_query.query_load(conf)
+
+    def measure(query, reps):
+        times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            ds.query(query, 'day')
+            times.append((time.monotonic() - t0) * 1000)
+        times.sort()
+        return (times[len(times) // 2],
+                times[min(len(times) - 1, int(len(times) * 0.95))])
+
+    ds.query(q(), 'day')            # warm
+    full_p50, full_p95 = measure(q(), 11)
+    win_p50, win_p95 = measure(
+        q('2014-06-01', '2014-07-01'), 11)
+    os.environ['DN_QUERY_CONCURRENCY'] = '1'
+    try:
+        seq_p50, _ = measure(q(), 5)
+    finally:
+        os.environ.pop('DN_QUERY_CONCURRENCY', None)
+    shutil.rmtree(idx, ignore_errors=True)
+    os.unlink(datafile)
+    return {
+        'index_query_shards': nshards,
+        'index_query_build_records_per_sec': round(n / build_s),
+        'index_query_p50_ms': round(full_p50, 2),
+        'index_query_p95_ms': round(full_p95, 2),
+        'index_query_window_p50_ms': round(win_p50, 2),
+        'index_query_window_p95_ms': round(win_p95, 2),
+        'index_query_sequential_p50_ms': round(seq_p50, 2),
+    }
+
+
+def kernel_bench_extras(datafile):
+    """Chip-level measurements (None values when no device backend)."""
+    try:
+        from dragnet_tpu import devbench
+        main = devbench.kernel_bench(datafile, QUERY)
+    except Exception as e:
+        sys.stderr.write('bench: kernel bench unavailable: %s\n' % e)
+        return {}
+    if main is None:
+        return {}
+    out = {
+        'device_kernel_records_per_sec':
+            round(main['kernel_records_per_sec']),
+        'device_kernel_ms_per_batch':
+            round(main['kernel_ms_per_batch'], 3),
+        'device_kernel_segments': main['segments'],
+        'device_hbm_gb_per_sec': round(main['hbm_gb_per_sec'], 2),
+        'device_h2d_gb_per_sec': round(main['h2d_gb_per_sec'], 3),
+        'device_h2d_bytes_per_record':
+            round(main['h2d_bytes_per_record'], 1),
+        'device_d2h_mb_per_sec': round(main['d2h_mb_per_sec'], 2),
+        'device_kind': main['device_kind'],
+    }
+    try:
+        pl = devbench.kernel_bench(datafile, PALLAS_QUERY)
+    except Exception:
+        pl = None
+    if pl is not None:
+        out['device_pallas_records_per_sec'] = \
+            round(pl['kernel_records_per_sec'])
+        out['device_pallas_engaged'] = pl['pallas']
+        if 'aggregate_flops_per_sec' in pl:
+            out['device_aggregate_tflops'] = \
+                round(pl['aggregate_flops_per_sec'] / 1e12, 3)
+        if 'mfu_pct' in pl:
+            out['device_mfu_pct'] = round(pl['mfu_pct'], 2)
+    return out
+
+
+# peak-RSS budget for the 10M-record scale leg: results are bounded by
+# output tuples, so memory must not scale with input records (the
+# reference's 250k-record test held 90 MB; 40x the records gets a
+# proportionally tighter per-record bar, not a 40x budget)
+SCALE_RSS_BUDGET_MB = 4096
+
+
+def scale_leg(tmpdir, n):
+    """10M-record scan+build in a subprocess (its peak RSS is then this
+    leg's alone, not the whole bench's)."""
+    import subprocess
+    code = (
+        'import json, os, resource, sys, time\n'
+        'sys.path.insert(0, %r)\n'
+        'import bench\n'
+        'from dragnet_tpu import query as mod_query\n'
+        'n = %d\n'
+        'datafile = os.path.join(%r, "scale.log")\n'
+        'bench.gen_to_file(n, datafile)\n'
+        't0 = time.monotonic()\n'
+        'r = bench.run_scan(datafile,'
+        ' mod_query.query_load(dict(bench.QUERY)))\n'
+        'scan_s = time.monotonic() - t0\n'
+        'npts = len(r.points)\n'
+        'idx = datafile + ".idx"\n'
+        'metrics = [mod_query.metric_deserialize(dict(m))'
+        ' for m in bench.METRICS]\n'
+        't0 = time.monotonic()\n'
+        'bench.make_ds(datafile, idx).build(metrics, "day")\n'
+        'build_s = time.monotonic() - t0\n'
+        'rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss'
+        ' / 1024.0\n'
+        'import shutil\n'
+        'shutil.rmtree(idx, ignore_errors=True)\n'
+        'os.unlink(datafile)\n'
+        'print(json.dumps({"scale_records": n,'
+        ' "scale_scan_records_per_sec": round(n / scan_s),'
+        ' "scale_build_records_per_sec": round(n / build_s),'
+        ' "scale_output_points": npts,'
+        ' "scale_peak_rss_mb": round(rss_mb, 1)}))\n'
+    ) % (os.path.dirname(os.path.abspath(__file__)), n, tmpdir)
+    out = subprocess.run([sys.executable, '-c', code],
+                         capture_output=True, timeout=1800)
+    if out.returncode != 0:
+        sys.stderr.write('bench: scale leg failed: %s\n'
+                         % out.stderr.decode()[-500:])
+        return {}
+    res = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    res['scale_rss_budget_mb'] = SCALE_RSS_BUDGET_MB
+    res['scale_rss_within_budget'] = \
+        res['scale_peak_rss_mb'] <= SCALE_RSS_BUDGET_MB
+    return res
 
 
 def main():
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
-    # the large config exercises the device path (auto mode's escalation
-    # threshold sits at 512k records; the device needs batches to
-    # amortize dispatch): forced-device, forced-host and auto all run at
-    # this size so BENCH_r*.json captures the chip, the host engine, and
-    # the router's choice side by side
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)
 
     import tempfile
+    import shutil
 
     tmpdir = tempfile.mkdtemp(prefix='dn_bench_')
     datafile = os.path.join(tmpdir, 'bench.log')
     largefile = os.path.join(tmpdir, 'bench_large.log')
-    t0 = time.time()
+    t0 = time.monotonic()
     gen_to_file(nrecords, datafile)
     gen_to_file(large_n, largefile)
-    gen_s = time.time() - t0
+    gen_s = time.monotonic() - t0
     with open(datafile) as f:
         lines = [f.readline().rstrip('\n') for _ in range(host_sample)]
 
-    def q():
-        return mod_query.query_load(QUERY)
+    runs = Runs()
 
     # warm up (jit compilation / native-library build happens here,
     # outside the timed region, as it would be cached in a long-running
     # service)
-    run_scan(datafile, q())
+    run_scan(datafile, mod_query.query_load(dict(QUERY)))
 
-    # best-of-3: the primary scan is a sub-second measurement whose
-    # run-to-run noise (page cache, allocator, CPU frequency) is
-    # comparable to the round-over-round drift being tracked
-    vec_s = float('inf')
-    for _ in range(3):
-        t0 = time.time()
-        result = run_scan(datafile, q())
-        vec_s = min(vec_s, time.time() - t0)
-    npoints = len(result.points)
+    # per-record reference rate (the architectural stand-in for the
+    # reference's stream-per-record model; vs_baseline denominator)
+    t0 = time.monotonic()
+    run_host(lines[:host_sample], mod_query.query_load(dict(QUERY)))
+    host_rps = host_sample / (time.monotonic() - t0)
 
-    t0 = time.time()
-    run_host(lines[:host_sample], q())
-    host_s = time.time() - t0
+    # r1-r4 comparability leg: 300k auto scan
+    scan300_rps, npoints, _ = timed_scan(
+        runs, 'scan_300k', datafile, nrecords, QUERY, None)
 
-    # the large-scan trio: vectorized host engine (no device routing),
-    # forced device, and the auto router's own choice
-    host_large_rps, np_host, _ = _timed_scan(largefile, large_n,
-                                             'vector')
-    device_rps, np_dev, dev_batches = _timed_scan(largefile, large_n,
-                                                  'jax')
-    auto_large_rps, np_auto, _ = _timed_scan(largefile, large_n, None)
+    # the large trio — auto is the headline (it must beat the best
+    # single engine or the router is costing throughput)
+    host_large, np_host, _ = timed_scan(
+        runs, 'scan_large_host', largefile, large_n, QUERY, 'vector')
+    device_large, np_dev, dev_batches = timed_scan(
+        runs, 'scan_large_device', largefile, large_n, QUERY, 'jax')
+    auto_large, np_auto, _ = timed_scan(
+        runs, 'scan_large_auto', largefile, large_n, QUERY, None)
     assert np_dev == np_auto == np_host, 'engine outputs diverge'
     device_engaged = dev_batches > 0
 
-    # high-cardinality group-by: output tuples ~ records (url x raw
-    # latency), exercising the sparse/deferred merge path whose memory
-    # is bounded by unique tuples (the reference's scaling law,
-    # README.md:668-681)
-    hc_query = {'breakdowns': [{'name': 'req.url'},
-                               {'name': 'latency'}]}
-    run_scan(datafile, mod_query.query_load(dict(hc_query)))  # warm
-    hc_s = float('inf')
-    for _ in range(2):
-        t0 = time.time()
-        hc_result = run_scan(datafile,
-                             mod_query.query_load(dict(hc_query)))
-        hc_s = min(hc_s, time.time() - t0)
-    hc_rps = nrecords / hc_s
-    hc_tuples = len(hc_result.points)
+    # high-cardinality at scale: host sparse/deferred merge vs the
+    # device-resident sparse sort-merge program
+    hc_host, hc_tuples, _ = timed_scan(
+        runs, 'highcard_host', largefile, large_n, HC_QUERY, 'vector',
+        repeats=2)
+    hc_dev, hc_tuples_d, hc_batches = timed_scan(
+        runs, 'highcard_device', largefile, large_n, HC_QUERY, 'jax',
+        repeats=2)
+    assert hc_tuples == hc_tuples_d, 'highcard outputs diverge'
 
-    build_rps, query_p50 = run_build_query(datafile, nrecords)
+    # build trio (3-metric daily index)
+    build_auto, _ = timed_build(runs, 'build_auto', largefile, large_n,
+                                None)
+    build_host, _ = timed_build(runs, 'build_host', largefile, large_n,
+                                'vector')
+    build_dev, build_stacked = timed_build(
+        runs, 'build_device', largefile, large_n, 'jax')
 
-    vec_rps = nrecords / vec_s
-    host_rps = host_sample / host_s
+    iq = index_query_bench(tmpdir)
+    kb = kernel_bench_extras(largefile)
+
+    scale = {}
+    if os.environ.get('DN_BENCH_SCALE') == '1':
+        scale = scale_leg(tmpdir,
+                          int(os.environ.get('DN_BENCH_SCALE_RECORDS',
+                                             '10000000')))
+
+    headline = runs.best('scan_large_auto')
 
     sys.stderr.write(
-        'bench: %d records, %d output points; gen %.1fs; '
-        'dn-scan %.2fs (%.0f rec/s); host-sample %.2fs (%.0f rec/s); '
-        'large(%d): host %.0f, device %.0f, auto %.0f rec/s; '
-        'highcard %.0f rec/s (%d tuples); '
-        'dn-build %.0f rec/s; index-query p50 %.1fms; '
-        'native=%s threads=%s\n'
-        % (nrecords, npoints, gen_s, vec_s, vec_rps, host_s, host_rps,
-           large_n, host_large_rps, device_rps, auto_large_rps,
-           hc_rps, hc_tuples,
-           build_rps, query_p50 * 1000,
-           os.environ.get('DN_NATIVE', '1'),
-           os.environ.get('DN_SCAN_THREADS', 'auto')))
-    import shutil
+        'bench: headline(auto@%d) %.0f rec/s; 300k %.0f; '
+        'large host %.0f dev %.0f; highcard host %.0f dev %.0f '
+        '(%d tuples, dev batches %d); build auto %.0f host %.0f '
+        'dev %.0f (stacked %d); iq p50 %.1fms/%d shards; '
+        'kernel %s rec/s\n'
+        % (large_n, headline, scan300_rps, host_large, device_large,
+           hc_host, hc_dev, hc_tuples, hc_batches, build_auto,
+           build_host, build_dev, build_stacked,
+           iq.get('index_query_p50_ms', -1),
+           iq.get('index_query_shards', 0),
+           kb.get('device_kernel_records_per_sec', 'n/a')))
+
     shutil.rmtree(tmpdir, ignore_errors=True)
+
+    extra = {
+        'headline_config':
+            '%d-record multi-field group-by scan, auto engine'
+            % large_n,
+        'large_records': large_n,
+        'scan_300k_records_per_sec': round(scan300_rps),
+        'scan_300k_output_points': npoints,
+        'host_large_records_per_sec': round(host_large),
+        'device_large_records_per_sec':
+            round(device_large) if device_engaged else None,
+        'device_path_engaged': device_engaged,
+        'auto_large_records_per_sec': round(auto_large),
+        'highcard_records_per_sec': round(hc_dev),
+        'highcard_host_records_per_sec': round(hc_host),
+        'highcard_device_engaged': hc_batches > 0,
+        'highcard_output_tuples': hc_tuples,
+        'build_records_per_sec': round(build_auto),
+        'build_host_records_per_sec': round(build_host),
+        'build_device_records_per_sec': round(build_dev),
+        'build_device_stacked_batches': build_stacked,
+        'runs': runs.summary(),
+    }
+    extra.update(iq)
+    extra.update(kb)
+    extra.update(scale)
 
     print(json.dumps({
         'metric': 'scan_records_per_sec',
-        'value': round(vec_rps),
+        'value': round(headline),
         'unit': 'records/s',
-        'vs_baseline': round(vec_rps / host_rps, 3),
-        'extra': {
-            'large_records': large_n,
-            'host_large_records_per_sec': round(host_large_rps),
-            'device_large_records_per_sec':
-                round(device_rps) if device_engaged else None,
-            'device_path_engaged': device_engaged,
-            'auto_large_records_per_sec': round(auto_large_rps),
-            'highcard_records_per_sec': round(hc_rps),
-            'highcard_output_tuples': hc_tuples,
-            'build_records_per_sec': round(build_rps),
-            'index_query_p50_ms': round(query_p50 * 1000, 2),
-        },
+        'vs_baseline': round(headline / host_rps, 3),
+        'extra': extra,
     }))
 
 

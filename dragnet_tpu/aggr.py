@@ -23,6 +23,21 @@ maps for columnar batches and merge into the same flat structure.
 from . import jsvalues as jsv
 
 
+def _np():
+    import numpy
+    return numpy
+
+
+def _unique_rows_2(a, b):
+    """np.unique(return_index/inverse) over 2 int64 columns when their
+    fused span overflows int64 (degenerate; row-wise unique instead)."""
+    np = _np()
+    mat = np.stack([a, b], axis=1)
+    _, first_idx, inv = np.unique(mat, axis=0, return_index=True,
+                                  return_inverse=True)
+    return first_idx, inv.reshape(-1), None
+
+
 def _is_array_index(s):
     if not s or not s.isdigit():
         return False
@@ -58,6 +73,12 @@ class Aggregator(object):
         self.flat = {}
         self.total = 0  # the no-decomposition case
         self.nrecords = 0
+        # columnar result (set_columnar): code arrays + weights in
+        # first-occurrence order; high-cardinality scans skip the
+        # per-tuple flat-dict writes entirely
+        self._cols = None
+        self._cweights = None
+        self._cdec = None
 
     def write(self, fields, value):
         if self.stage is not None:
@@ -100,6 +121,187 @@ class Aggregator(object):
         flat = self.flat
         flat[keys] = flat.get(keys, 0) + value
 
+    def set_columnar(self, cols, weights, decoders):
+        """Install the aggregate as parallel code columns instead of
+        per-tuple flat-dict writes (the vectorized engines' deferred
+        merge hands its unique tuples here): `cols` are int64 arrays in
+        first-occurrence order — engine string-dictionary codes for
+        plain columns, raw ordinals for bucketized ones — `weights`
+        float64, `decoders` one ('str', values_list) or ('ord', None)
+        per decomp.  points()/rows() then order and decode columnarly;
+        Python-object work becomes O(output tuples), once.
+
+        Requires an empty flat map (callers merge any flat prefix into
+        the columns first) and replaces it entirely."""
+        assert not self.flat and len(cols) == len(self.decomps)
+        self._cols = [_np().asarray(c, dtype='int64') for c in cols]
+        if isinstance(weights, list):
+            self._cweights = weights     # exact Python numbers
+        else:
+            self._cweights = _np().asarray(weights, dtype='float64')
+        self._cdec = decoders
+
+    # results at least this large take the columnar order/decode even
+    # when they arrived as per-tuple flat writes (the MT merge path):
+    # the nested-dict walk is the dominant cost of emitting a
+    # high-cardinality result
+    FLAT_COLUMNAR_MIN = 8192
+
+    def _flat_to_columnar(self):
+        """Convert the flat map to columns (first-occurrence order is
+        the dict's insertion order) so points()/rows() vectorize."""
+        np = _np()
+        cols = [[] for _ in self.decomps]
+        encs = []
+        decoders = []
+        for name in self.decomps:
+            if name in self.bucketizers:
+                encs.append(None)
+                decoders.append(('ord', None))
+            else:
+                vals = []
+                encs.append(({}, vals))
+                decoders.append(('str', vals))
+        weights = []
+        for keys, w in self.flat.items():
+            for col, enc, k in zip(cols, encs, keys):
+                if enc is None:
+                    col.append(k)
+                else:
+                    index, vals = enc
+                    c = index.get(k)
+                    if c is None:
+                        c = len(vals)
+                        index[k] = c
+                        vals.append(k)
+                    col.append(c)
+            weights.append(w)
+        self.flat = {}
+        self.set_columnar([np.asarray(c, dtype=np.int64) for c in cols],
+                          weights, decoders)
+
+    def _columnar_order(self):
+        """JS property-enumeration order over the columnar tuples,
+        vectorized.  Per level, a key's rank is (numeric-likeness,
+        int value) for array-index-like keys and (non-numeric,
+        first-occurrence-within-parent) otherwise — exactly the
+        js_key_order applied at every node of the nested walk.  The
+        within-parent arrival rank is the first occurrence index of
+        the (parent-group, code) pair in arrival order; a stable
+        lexsort over all levels reproduces the nested enumeration."""
+        np = _np()
+        n = len(self._cweights)
+        levels = []   # (numeric-class, sort-value) per level
+        gid = np.zeros(n, dtype=np.int64)
+        ngroups = 1
+        for codes, dec in zip(self._cols, self._cdec):
+            if dec[0] == 'ord':
+                # int keys: all numeric-class, ascending by value
+                nn = np.zeros(n, dtype=np.int8)
+                sk = codes
+                span = int(codes.max()) - int(codes.min()) + 1 \
+                    if n else 1
+                pair_code = codes - (int(codes.min()) if n else 0)
+            else:
+                values = dec[1]
+                # per-code classification (one pass over the dict)
+                cn = len(values)
+                knn = np.empty(cn, dtype=np.int8)
+                kval = np.zeros(cn, dtype=np.int64)
+                for i, s in enumerate(values):
+                    if isinstance(s, str) and _is_array_index(s):
+                        knn[i] = 0
+                        kval[i] = int(s)
+                    elif isinstance(s, int) and \
+                            not isinstance(s, bool):
+                        knn[i] = 0
+                        kval[i] = s
+                    else:
+                        knn[i] = 1
+                nn = knn[codes]
+                sk = kval[codes]
+                span = cn
+                pair_code = codes
+            # within-parent arrival rank for non-numeric keys: first
+            # occurrence of the (group, code) pair in arrival order
+            if ngroups * span < 2 ** 62:
+                pair = gid * span + pair_code
+                uniq, first_idx, inv = np.unique(
+                    pair, return_index=True, return_inverse=True)
+            else:
+                first_idx, inv, _ = _unique_rows_2(gid, pair_code)
+            sk = np.where(nn == 1, first_idx[inv], sk)
+            levels.append((nn, sk))
+            gid = inv.reshape(-1)
+            ngroups = len(first_idx)
+        if not n:
+            return np.zeros(0, dtype=np.int64)
+        # lexsort: last key is primary -> feed levels deepest-first,
+        # each level's class before its value (value least significant)
+        seq = []
+        for nn, sk in reversed(levels):
+            seq.append(sk)
+            seq.append(nn)
+        return np.lexsort(tuple(seq))
+
+    def _columnar_points(self, as_rows):
+        np = _np()
+        order = self._columnar_order()
+        n = len(order)
+        cols_out = []
+        for codes, dec, name in zip(self._cols, self._cdec,
+                                    self.decomps):
+            cc = codes[order]
+            if dec[0] == 'ord':
+                # bucket-min per unique ordinal (few), mapped
+                bz = self.bucketizers[name]
+                uniq = np.unique(cc)
+                table = {int(o): bz.bucket_min(int(o)) for o in uniq}
+                cols_out.append([table[int(o)] for o in cc.tolist()])
+            else:
+                values = np.asarray(dec[1], dtype=object)
+                cols_out.append(values[cc].tolist())
+        if isinstance(self._cweights, list):
+            # flat->columnar conversion keeps the exact stored Python
+            # numbers (no f64 round trip)
+            ol = order.tolist()
+            weights = [self._cweights[i] for i in ol]
+        else:
+            wl = self._cweights[order].tolist()
+            weights = [int(w) if w.is_integer() else w for w in wl]
+        if not as_rows and self.stage is not None:
+            # (rows() never bumped noutputs on the flat path either)
+            self.stage.bump('noutputs', n)
+        if as_rows:
+            if not cols_out:
+                return [list(t) for t in zip(weights)]
+            # rows carry ordinal/key form, not bucket-min: decode ords
+            # back from the sorted codes
+            raw = []
+            for codes, dec in zip(self._cols, self._cdec):
+                cc = codes[order]
+                raw.append(cc.tolist() if dec[0] == 'ord'
+                           else np.asarray(dec[1],
+                                           dtype=object)[cc].tolist())
+            return [list(t) + [w] for t, w in zip(zip(*raw), weights)]
+        names = self.decomps
+        # literal dict construction: dict(zip(...)) costs ~2x at
+        # hundreds of thousands of output tuples
+        if len(names) == 1:
+            n0, = names
+            return [({n0: a}, w) for a, w in zip(cols_out[0], weights)]
+        if len(names) == 2:
+            n0, n1 = names
+            return [({n0: a, n1: b}, w) for a, b, w
+                    in zip(cols_out[0], cols_out[1], weights)]
+        if len(names) == 3:
+            n0, n1, n2 = names
+            return [({n0: a, n1: b, n2: c}, w) for a, b, c, w
+                    in zip(cols_out[0], cols_out[1], cols_out[2],
+                           weights)]
+        return [(dict(zip(names, t)), w)
+                for t, w in zip(zip(*cols_out), weights)]
+
     def _walk(self):
         """Yield (keys_tuple, weight) in JS property-enumeration order.
 
@@ -138,6 +340,11 @@ class Aggregator(object):
     def points(self):
         """Aggregated points: fields carry bucket-min values for bucketized
         fields (re-ingestable), strings otherwise."""
+        if self._cols is None and \
+                len(self.flat) >= self.FLAT_COLUMNAR_MIN:
+            self._flat_to_columnar()
+        if self._cols is not None:
+            return self._columnar_points(False)
         out = []
         if not self.decomps:
             out.append(({}, self.total))
@@ -160,6 +367,11 @@ class Aggregator(object):
         """Flattened result rows in ordinal form: [key..., weight] per row,
         or a bare total when there are no decompositions (what the
         reference's SkinnerFlattener emits with resultsAsPoints:false)."""
+        if self._cols is None and \
+                len(self.flat) >= self.FLAT_COLUMNAR_MIN:
+            self._flat_to_columnar()
+        if self._cols is not None:
+            return self._columnar_points(True)
         if not self.decomps:
             return [self.total]
         rv = []

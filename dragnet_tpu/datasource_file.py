@@ -894,6 +894,13 @@ class DatasourceFile(object):
         LOG.debug('query start', indexroot=root, nindexes=len(paths),
                   interval=interval)
         conc = min(10, len(paths))
+        try:
+            # bench/testing override: DN_QUERY_CONCURRENCY=1 measures
+            # the sequential fan-in against the default overlap
+            conc = max(1, min(int(os.environ.get(
+                'DN_QUERY_CONCURRENCY', conc)), len(paths)))
+        except ValueError:
+            pass
         if conc > 1:
             from concurrent.futures import ThreadPoolExecutor
             with ThreadPoolExecutor(max_workers=conc) as pool:

@@ -67,6 +67,14 @@ I16MAX = 2 ** 15 - 1
 # block, not a fetch) — bounds pinned input-buffer memory
 SYNC_EVERY_BATCHES = 32
 
+# device-resident sparse set (high-cardinality mode): initial capacity,
+# growth ceiling.  24 bytes/slot of HBM (a 1M-slot set is 24 MB —
+# nothing next to device memory, and starting big avoids the mid-scan
+# flush a capacity growth forces); the host-side pressure guard
+# flushes + grows before a batch could overflow the set
+SPARSE_CAP0 = 1 << 20
+SPARSE_CAP_MAX = 1 << 23
+
 LOG = mod_log.get('device-scan')
 
 
@@ -74,7 +82,8 @@ LOG = mod_log.get('device-scan')
 # accumulator means those results never merged
 _SCAN_LEAKS = watchdog.LeakCheck(
     'device scan(s) with unflushed accumulators; results may be '
-    'incomplete', lambda s: s._acc is not None)
+    'incomplete',
+    lambda s: s._acc is not None or bool(s._pending_flush))
 
 
 def _rate_field(r):
@@ -266,6 +275,10 @@ class DeviceScan(VectorScan):
         self._shadow_ctx = None   # set by enable_shadow (MT path)
         self._shadow = None
         self._sticky = None       # upload-profile state (see _try_device)
+        self._sparse_cap = SPARSE_CAP0
+        self._sparse_ub = 0       # unique-count upper bound this epoch
+        self._pending_flush = []  # async-prefetched epochs (see
+        self._prefetched = False  # _prefetch_flush)
         self._plans = None            # built lazily from the query
         self._epoch_sig = None
         self._programs = None
@@ -355,6 +368,13 @@ class DeviceScan(VectorScan):
                      (s, 'noutputs', True)]
         spec.append((self.aggr.stage, 'ninputs', True))
         spec.append((self.aggr.stage, 'nnonnumeric', False))
+        # records aggregated through the unbounded-cardinality path:
+        # the host engine bumps this in _sparse_merge; the device
+        # sparse program emits the same value (0 in dense mode).  The
+        # counts can differ from a pure-host run only when the dense
+        # budget decision itself straddles MAX_DENSE_SEGMENTS between
+        # the host's per-batch radices and the device's pow2 caps.
+        spec.append((self.aggr.stage, 'nspillrecords', False))
         self._counter_spec = spec
 
     # -- per-batch entry ---------------------------------------------------
@@ -374,11 +394,137 @@ class DeviceScan(VectorScan):
         self._host_records += n
         VectorScan._process(self, provider, weights, alive=alive)
 
+    # once the stream is this far along, the accumulator-so-far is
+    # compacted and its fetch issued ASYNC, overlapping the tunnel's
+    # slow device->host leg with the remaining parse/compute instead
+    # of serializing it after the last batch
+    PREFETCH_PROGRESS = 0.7
+
     def set_progress(self, bytes_done, bytes_total):
         """Stream-progress hook (the file datasource reports bytes
         consumed vs total): lets auto mode estimate remaining work
-        before committing to a device switch."""
+        before committing to a device switch, and triggers the one-time
+        async flush prefetch late in the stream."""
         self._progress = (bytes_done, bytes_total)
+        if not self._prefetched and self._acc is not None and \
+                bytes_total > 0 and \
+                bytes_done >= self.PREFETCH_PROGRESS * bytes_total:
+            self._prefetched = True
+            self._prefetch_flush()
+
+    def _prefetch_flush(self):
+        """Compact the current epoch on device and issue its fetch
+        asynchronously; accumulation continues in a fresh accumulator
+        and the result is drained (in order) at the next _flush."""
+        acc = self._acc
+        meta = self._acc_meta
+        nbatches = self._acc_batch
+        if acc is None:
+            return
+        try:
+            cap = meta.get('sparse_cap')
+            if cap:
+                k = min(cap, _pow2(max(self._sparse_ub, 1)))
+                out = _sparse_program(cap, k,
+                                      tuple(meta['caps']))(acc)
+            elif meta['cols'] and \
+                    meta['ns'] >= self.COMPACT_MIN_SEGMENTS:
+                k = min(int(acc[0].shape[0]), self.COMPACT_K)
+                out = _compact_program(int(acc[0].shape[0]), k)(acc)
+            else:
+                return    # small fetch: nothing worth overlapping
+            for a in out:
+                if hasattr(a, 'copy_to_host_async'):
+                    try:
+                        a.copy_to_host_async()
+                    except Exception:
+                        pass
+        except Exception:
+            LOG.debug('flush prefetch failed; staying synchronous')
+            return
+        # keep the acc referenced: a sparse prefetch sized by the ub
+        # bound never refetches, but the dense speculative width can
+        self._pending_flush.append((meta, nbatches, acc, out))
+        self._acc = None
+        self._acc_meta = None
+        self._acc_batch = 0
+        self._sparse_ub = 0
+
+    def _drain_pending(self):
+        pending = self._pending_flush
+        self._pending_flush = []
+        for meta, nbatches, acc, out in pending:
+            if nbatches:
+                self.aggr.stage.bump_hidden('ndevicebatches', nbatches)
+            cap = meta.get('sparse_cap')
+            if cap:
+                cols, w32, wof, cvec, stats = out
+                st = np.asarray(stats)
+                n = int(st[0])
+                k = int(cols[0].shape[0])
+                if n > k or bool(np.asarray(wof)):
+                    # ub bound failed or i32 weight overflow: refetch
+                    fetched = _sparse_fetch(acc, _pow2(max(n, 1)),
+                                            meta['caps'])
+                    if fetched is None:   # device fetch error: full
+                        fetched = _sparse_full_result(acc,
+                                                      meta['caps'])
+                    cols_np, wsumf, cvec_np, st = fetched
+                else:
+                    cols_np = [c[:n].astype(np.int64)
+                               for c in _fetch_arrays(cols)]
+                    wsumf = np.asarray(w32)[:n].astype(np.float64)
+                    cvec_np = np.asarray(cvec)
+                if int(st[1]):
+                    raise RuntimeError(
+                        'device sparse aggregation overflowed its '
+                        'resident set (cap=%d)' % cap)
+                self.aggr.stage.bump_hidden('ncompactflush', 1)
+                self._emit_counters(cvec_np)
+                self._emit_cols(meta, cols_np, wsumf)
+            else:
+                cnt, segs, dense, cvec = out
+                n = int(np.asarray(cnt))
+                k = int(segs.shape[0])
+                if n > k:
+                    fetched = _compact_fetch(acc, meta['ns'],
+                                             _pow2(n))
+                    if fetched is None:   # device fetch error: full
+                        fetched = _dense_full_result(acc)
+                    segs_np, wsumf, cvec_np = fetched
+                else:
+                    segs_np = np.asarray(segs)[:n].astype(np.int64)
+                    wsumf = np.asarray(dense)[:n].astype(np.float64)
+                    cvec_np = np.asarray(cvec)
+                self.aggr.stage.bump_hidden('ncompactflush', 1)
+                self._emit_counters(cvec_np)
+                self._decode_emit(meta, segs_np, wsumf)
+
+    def _emit_counters(self, cvec):
+        for (stage, name, always), v in zip(self._counter_spec, cvec):
+            v = int(v)
+            if always or v:
+                stage.bump(name, v)
+
+    def _decode_emit(self, meta, segs, wsum):
+        """Decode fused segment codes -> global per-column codes and
+        emit (shared by the sync flush paths and the async drain)."""
+        if len(segs) == 0:
+            return
+        self._emit_cols(meta, _decode_fused(segs, meta['caps']), wsum)
+
+    def _emit_cols(self, meta, col_codes, wsum):
+        """Per-column codes -> global codes (window offsets applied)
+        -> the shared emit path."""
+        if len(wsum) == 0:
+            return
+        gcols = []
+        for (kind, lo), cc in zip(meta['cols'], col_codes):
+            if kind == 'str':
+                gcols.append(np.asarray(cc, dtype=np.int64))
+            else:
+                gcols.append(np.asarray(cc, dtype=np.int64) + lo)
+        self._emit_unique(gcols, wsum)
 
     def note_external_batch(self, n):
         """A batch of n records was processed outside this scanner (the
@@ -866,9 +1012,19 @@ class DeviceScan(VectorScan):
         ns = 1
         for c in new_caps:
             ns *= c
+        sparse = False
         if ns > MAX_DENSE_SEGMENTS:
-            self._disabled = True
-            return None
+            # high-cardinality: no dense accumulator fits.  Run the
+            # SPARSE device program instead — fused i64 keys sort-merged
+            # into a device-resident compacted set (keys/weights/first),
+            # so the host only ever sees unique tuples.  The reference's
+            # known failure mode was exactly this workload
+            # (README.md:668-681).  Excluded under a mesh (a sparse set
+            # has no psum merge) and when the fused key would overflow.
+            if self._device_mesh() is not None or ns > (1 << 62):
+                self._disabled = True
+                return None
+            sparse = True
 
         # commit plan-state changes; epoch flip rebuilds the program
         for p, cap, lo, host, wset in pending:
@@ -879,6 +1035,12 @@ class DeviceScan(VectorScan):
             self._flush()
             self._epoch_sig = sig
             self._programs = None
+
+        # the overflow guard runs AFTER any epoch-flip flush (a flush
+        # resets the unique-count bound, which must then re-reserve
+        # THIS batch or the bound undercounts by a batch)
+        if sparse and not self._sparse_guard(n):
+            return None
 
         # leaf outcome tables (grown host-side, resident on device)
         for i, (key, leaf) in enumerate(self._leaf_list):
@@ -925,16 +1087,45 @@ class DeviceScan(VectorScan):
                 inputs['alive'][n:] = False
 
         profile = (w1, gen_alive, tuple(filter_profile),
-                   tuple(kvalid_profile), use_dstats)
+                   tuple(kvalid_profile), use_dstats,
+                   (self._sparse_cap if sparse else 0))
         return (pn, profile, tuple(new_caps), ns, total_w)
 
-    def _ensure_acc(self, acc_init, caps, ns):
+    def _sparse_guard(self, n):
+        """Prevent resident-set overflow BEFORE folding a batch: track
+        an upper bound on uniques (exact count at last check + records
+        since); when this batch could overflow, sync-fetch the true
+        count from the accumulator, and if still at risk flush the
+        (correct-so-far) epoch and grow the capacity.  Returns False
+        when the scan must take the host path instead (capacity
+        ceiling: device permanently disabled for this scan)."""
+        while True:
+            cap = self._sparse_cap
+            if self._sparse_ub + n <= cap:
+                self._sparse_ub += n
+                return True
+            if self._acc is not None and len(self._acc) == 5:
+                nuniq = int(np.asarray(self._acc[4])[0])
+                if nuniq + n <= cap:
+                    self._sparse_ub = nuniq + n
+                    return True
+            self._flush()
+            if cap >= SPARSE_CAP_MAX:
+                self._disabled = True
+                LOG.info('sparse set capacity ceiling reached; '
+                         'host path takes over', cap=cap)
+                return False
+            self._sparse_cap = cap * 4
+            LOG.debug('sparse set grown', cap=self._sparse_cap)
+
+    def _ensure_acc(self, acc_init, caps, ns, sparse_cap=0):
         if self._acc is None:
             self._acc = acc_init()
             self._acc_meta = {
                 'caps': tuple(caps),
                 'cols': [(p.kind, p.lo) for p in self._plans],
                 'ns': ns,
+                'sparse_cap': sparse_cap,
             }
             self._acc_batch = 0
 
@@ -958,7 +1149,8 @@ class DeviceScan(VectorScan):
         pn, profile, caps, ns, total_w = staged
         progs, use_pallas = self._staged_programs(staged)
         run = progs.run_pallas if use_pallas else progs.run_scatter
-        self._ensure_acc(progs.acc_init, caps, ns)
+        self._ensure_acc(progs.acc_init, caps, ns,
+                         sparse_cap=profile[-1])
         inputs[self._pfx + 'base'] = np.int64(self._acc_batch << 32)
         if self.capture_next:
             self.capture_next = False
@@ -1042,8 +1234,8 @@ class DeviceScan(VectorScan):
         mn = mod_native
         from .ops import pallas_kernels as pk
 
-        w1, gen_alive, filter_profile, kvalid_skip, use_dstats = \
-            profile
+        w1, gen_alive, filter_profile, kvalid_skip, use_dstats, \
+            sparse_cap = profile
         fprof = {f: (has_str, has_num, all_num)
                  for f, has_str, has_num, all_num in filter_profile}
         kvalid_skip = frozenset(kvalid_skip)
@@ -1267,7 +1459,23 @@ class DeviceScan(VectorScan):
                     codes.append(jnp.floor_divide(v, i32(p.step)) -
                                  i32(p.lo))
             counters.append(nnon)
+            counters.append(isum(alive) if sparse_cap
+                            else jnp.int32(0))   # nspillrecords
             cvec = jnp.stack(counters)
+
+            if sparse_cap:
+                # sparse mode: emit fused i64 keys + weights; the fold
+                # sort-merges them into the resident compacted set
+                i64 = jnp.int64
+                fused = jnp.zeros((bn,), dtype=i64)
+                for c, cap in zip(codes, caps):
+                    fused = fused * i64(cap) + c.astype(i64)
+                fused = jnp.where(alive, fused, i64(I64MAX))
+                if w1:
+                    wb = alive.astype(i64)
+                else:
+                    wb = jnp.where(alive, weights, i32(0)).astype(i64)
+                return cvec, fused, wb, gidx
 
             def merge(dense, first, cvec):
                 if maxis is None:
@@ -1352,6 +1560,70 @@ class DeviceScan(VectorScan):
                     jnp.minimum(acc[1], bfirst),
                     acc[2] + cvec.astype(i64))
 
+        def fold_sparse(args, acc):
+            """Sparse fold: sort-merge the batch's fused i64 keys into
+            the device-resident compacted set.  keys/first take the
+            per-key min (first-occurrence order preserved exactly),
+            weights sum, and the unique count rides along so the host
+            pressure guard can read it without a full fetch."""
+            assert mesh is None
+            keys0, wsum0, first0, cvec0, stats0 = acc
+            cvec_b, fused, wb, gidx = body(args, False)
+            i64 = jnp.int64
+            first_b = jnp.where(fused != i64(I64MAX),
+                                args[pfx + 'base'] + gidx.astype(i64),
+                                i64(I64MAX))
+            k = jnp.concatenate([keys0, fused])
+            w = jnp.concatenate([wsum0, wb])
+            f = jnp.concatenate([first0, first_b])
+            order = jnp.argsort(k)
+            ks = k[order]
+            ws = w[order]
+            fs = f[order]
+            newrun = jnp.concatenate(
+                [jnp.ones((1,), dtype=bool), ks[1:] != ks[:-1]])
+            seg = jnp.cumsum(newrun.astype(jnp.int32)) - jnp.int32(1)
+            valid = ks != i64(I64MAX)
+            nuniq = jnp.sum(newrun & valid).astype(i64)
+            # run ids past the capacity are dropped by the segment ops;
+            # the sticky overflow flag makes that loud at flush (the
+            # host guard prevents it from ever tripping)
+            keys1 = jax.ops.segment_min(ks, seg,
+                                        num_segments=sparse_cap)
+            wsum1 = jax.ops.segment_sum(ws, seg,
+                                        num_segments=sparse_cap)
+            first1 = jax.ops.segment_min(fs, seg,
+                                         num_segments=sparse_cap)
+            over = jnp.maximum(
+                stats0[1], (nuniq > sparse_cap).astype(i64))
+            return (keys1, wsum1, first1,
+                    cvec0 + cvec_b.astype(i64),
+                    jnp.stack([nuniq, over]))
+
+        if sparse_cap:
+            run_scatter = jax.jit(fold_sparse)
+
+            def fold_u(args, acc, use_pallas):
+                return fold_sparse(args, acc)
+
+            init_key = ('sparse', sparse_cap, ncnt)
+            acc_init = _ACC_INIT_CACHE.get(init_key)
+            if acc_init is None:
+                def make_sparse_init(cap_, ncnt_):
+                    jx, jn = get_jax()
+                    return jx.jit(lambda: (
+                        jn.full((cap_,), I64MAX, dtype=jn.int64),
+                        jn.zeros((cap_,), dtype=jn.int64),
+                        jn.full((cap_,), I64MAX, dtype=jn.int64),
+                        jn.zeros((ncnt_,), dtype=jn.int64),
+                        jn.zeros((2,), dtype=jn.int64)))
+                acc_init = make_sparse_init(sparse_cap, ncnt)
+                if len(_ACC_INIT_CACHE) >= 64:
+                    _ACC_INIT_CACHE.pop(next(iter(_ACC_INIT_CACHE)))
+                _ACC_INIT_CACHE[init_key] = acc_init
+            return _Programs(run_scatter, None, acc_init, fold_u,
+                             False)
+
         run_scatter = jax.jit(lambda args, acc: fold(args, acc, False))
         run_pallas = None
         have_pallas = pk.pallas_ok(ns) and pk.available()
@@ -1390,7 +1662,11 @@ class DeviceScan(VectorScan):
     def _flush(self):
         """Fetch the device accumulator (one round trip for the whole
         epoch: the copies are issued async and then awaited together)
-        and merge it into the insertion-ordered Aggregator."""
+        and merge it into the insertion-ordered Aggregator.  Any
+        async-prefetched epochs drain first, preserving emission
+        order."""
+        if self._pending_flush:
+            self._drain_pending()
         if self._acc is None:
             return
         acc = self._acc
@@ -1404,6 +1680,12 @@ class DeviceScan(VectorScan):
         # kept out of the --counters dump for golden byte parity)
         if nbatches:
             self.aggr.stage.bump_hidden('ndevicebatches', nbatches)
+        sparse_ub = self._sparse_ub
+        self._sparse_ub = 0
+
+        if meta.get('sparse_cap'):
+            self._flush_sparse(acc, meta, sparse_ub)
+            return
 
         segs = wsum = None
         if meta['cols'] and meta['ns'] >= self.COMPACT_MIN_SEGMENTS:
@@ -1423,10 +1705,7 @@ class DeviceScan(VectorScan):
             first = np.asarray(acc[1])
             cvec = np.asarray(acc[2])
 
-        for (stage, name, always), v in zip(self._counter_spec, cvec):
-            v = int(v)
-            if always or v:
-                stage.bump(name, v)
+        self._emit_counters(cvec)
         if not meta['cols']:
             self.aggr.write_key((), self._weight(float(dense[0])))
             return
@@ -1437,24 +1716,34 @@ class DeviceScan(VectorScan):
             order = np.argsort(first[occurred], kind='stable')
             segs = occurred[order]
             wsum = dense[segs].astype(np.float64)
-        elif len(segs) == 0:
-            return
-        rem = segs.copy()
-        caps = meta['caps']
-        col_codes = [None] * len(caps)
-        for ci in range(len(caps) - 1, -1, -1):
-            col_codes[ci] = rem % caps[ci]
-            rem = rem // caps[ci]
         # global codes for the shared emit path: device string codes
         # are already engine-dictionary codes; bucket codes offset
         # by the window origin give raw ordinals
-        gcols = []
-        for (kind, lo), cc in zip(meta['cols'], col_codes):
-            if kind == 'str':
-                gcols.append(np.asarray(cc, dtype=np.int64))
-            else:
-                gcols.append(np.asarray(cc, dtype=np.int64) + lo)
-        self._emit_unique(gcols, wsum)
+        self._decode_emit(meta, segs, wsum)
+
+    def _flush_sparse(self, acc, meta, sparse_ub):
+        """Flush the sparse (high-cardinality) accumulator: the set is
+        already compact, so fetch its occupied slots ordered by first
+        occurrence (decoded + narrowed on device), sized by the
+        epoch's unique-count upper bound."""
+        k0 = _pow2(max(min(sparse_ub, meta['sparse_cap']), 1)) \
+            if sparse_ub else self.COMPACT_K
+        fetched = _sparse_fetch(acc, k0, meta['caps'])
+        if fetched is None:
+            cols, wsum, cvec, stats = _sparse_full_result(
+                acc, meta['caps'])
+        else:
+            cols, wsum, cvec, stats = fetched
+            self.aggr.stage.bump_hidden('ncompactflush', 1)
+        if int(stats[1]):
+            # the host pressure guard exists to make this unreachable;
+            # if it ever trips, results are incomplete — fail loudly
+            raise RuntimeError(
+                'device sparse aggregation overflowed its resident set'
+                ' (cap=%d); results would be incomplete'
+                % meta['sparse_cap'])
+        self._emit_counters(cvec)
+        self._emit_cols(meta, cols, wsum)
 
 
 # jitted flush-compaction programs, keyed by (acc_len, K)
@@ -1484,6 +1773,173 @@ def _compact_program(acc_len, k):
         _COMPACT_CACHE.pop(next(iter(_COMPACT_CACHE)))
     _COMPACT_CACHE[key] = prog
     return prog
+
+
+def _narrow_dtype(cap):
+    if cap <= 256:
+        return 'uint8'
+    if cap <= 32768:
+        return 'int16'
+    return 'int32'
+
+
+def _sparse_program(cap, k, caps):
+    """Compacting fetch program for the sparse set: occupied slots
+    ordered by first occurrence, with the fused keys DECODED to
+    per-column codes on device and every output dtype-narrowed — the
+    device->host leg is the tunnel's slow side, so the fetch ships the
+    fewest bytes that can represent the result (plus an overflow flag
+    that triggers the full-precision fallback for weight sums beyond
+    i32)."""
+    key = ('sparse', cap, k, caps)
+    prog = _COMPACT_CACHE.get(key)
+    if prog is not None:
+        return prog
+    jax, jnp = get_jax()
+
+    def compact(acc):
+        keys, wsum, first, cvec, stats = acc
+        order = jnp.argsort(first)[:k]
+        ks = keys[order]
+        cols = []
+        div = 1
+        for cap_i in reversed(caps):
+            c = (ks // jnp.int64(div)) % jnp.int64(cap_i)
+            cols.append(c.astype(_narrow_dtype(cap_i)))
+            div *= cap_i
+        cols.reverse()
+        ws = wsum[order]
+        wof = jnp.any(ws > jnp.int64(I32MAX)) | \
+            jnp.any(ws < jnp.int64(I32MIN))
+        return tuple(cols), ws.astype(jnp.int32), wof, cvec, stats
+
+    prog = jax.jit(compact)
+    if len(_COMPACT_CACHE) >= 64:
+        _COMPACT_CACHE.pop(next(iter(_COMPACT_CACHE)))
+    _COMPACT_CACHE[key] = prog
+    return prog
+
+
+def _sparse_program_full(cap, k):
+    """Full-precision fallback (i64 keys+weights): used when a weight
+    sum overflows i32 (wof flag)."""
+    key = ('sparse64', cap, k)
+    prog = _COMPACT_CACHE.get(key)
+    if prog is not None:
+        return prog
+    jax, jnp = get_jax()
+
+    def compact(acc):
+        keys, wsum, first, cvec, stats = acc
+        order = jnp.argsort(first)[:k]
+        return keys[order], wsum[order], cvec, stats
+
+    prog = jax.jit(compact)
+    if len(_COMPACT_CACHE) >= 64:
+        _COMPACT_CACHE.pop(next(iter(_COMPACT_CACHE)))
+    _COMPACT_CACHE[key] = prog
+    return prog
+
+
+def _fetch_arrays(arrays):
+    """np.asarray over several device arrays; DN_PARALLEL_FETCH=1
+    fetches them on a small thread pool (measured ~40% faster over the
+    tunnel, but concurrent transfers can deadlock some device plugins,
+    so sequential is the safe default)."""
+    import os
+    arrays = list(arrays)
+    if len(arrays) <= 1 or \
+            os.environ.get('DN_PARALLEL_FETCH', '0') != '1':
+        return [np.asarray(a) for a in arrays]
+    import concurrent.futures as cf
+    with cf.ThreadPoolExecutor(min(4, len(arrays))) as ex:
+        return list(ex.map(np.asarray, arrays))
+
+
+def _decode_fused(keys, caps):
+    """Host-side fused-key decode (the fallback path)."""
+    rem = keys.copy()
+    cols = [None] * len(caps)
+    for ci in range(len(caps) - 1, -1, -1):
+        cols[ci] = rem % caps[ci]
+        rem = rem // caps[ci]
+    return cols
+
+
+def _issue_async(arrays):
+    for a in arrays:
+        if hasattr(a, 'copy_to_host_async'):
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                pass
+
+
+def _sparse_full_result(acc, caps):
+    """Full (uncompacted) fetch + host-side decode of a sparse
+    accumulator — the fallback when the compacting fetch fails."""
+    _issue_async(acc)
+    keys = np.asarray(acc[0])
+    wsums = np.asarray(acc[1])
+    first = np.asarray(acc[2])
+    cvec = np.asarray(acc[3])
+    stats = np.asarray(acc[4])
+    occurred = np.nonzero(first < I64MAX)[0]
+    order = np.argsort(first[occurred], kind='stable')
+    cols = _decode_fused(keys[occurred][order], caps)
+    wsum = wsums[occurred][order].astype(np.float64)
+    return cols, wsum, cvec, stats
+
+
+def _dense_full_result(acc):
+    """Full fetch of a dense accumulator in first-occurrence order —
+    the fallback when the compacting fetch fails."""
+    _issue_async(acc)
+    dense = np.asarray(acc[0])
+    first = np.asarray(acc[1])
+    cvec = np.asarray(acc[2])
+    occurred = np.nonzero(first < I64MAX)[0]
+    order = np.argsort(first[occurred], kind='stable')
+    segs = occurred[order]
+    return segs, dense[segs].astype(np.float64), cvec
+
+
+def _sparse_fetch(acc, k0, caps):
+    """Fetch the sparse accumulator's occupied slots in exact
+    first-occurrence order: (per-column code arrays i64, weights f64,
+    cvec, stats).  One round trip when the unique count fits the
+    speculative width."""
+    cap = int(acc[0].shape[0])
+    k = min(cap, k0)
+    try:
+        while True:
+            cols, w32, wof, cvec, stats = \
+                _sparse_program(cap, k, tuple(caps))(acc)
+            for a in list(cols) + [w32, cvec, stats]:
+                if hasattr(a, 'copy_to_host_async'):
+                    try:
+                        a.copy_to_host_async()
+                    except Exception:
+                        pass
+            st = np.asarray(stats)
+            n = int(st[0])
+            if n > k:
+                k = min(cap, _pow2(n))
+                continue
+            if bool(np.asarray(wof)):
+                keys, wsum, cvec, stats = \
+                    _sparse_program_full(cap, k)(acc)
+                kn = np.asarray(keys)[:n].astype(np.int64)
+                return (_decode_fused(kn, caps),
+                        np.asarray(wsum)[:n].astype(np.float64),
+                        np.asarray(cvec), np.asarray(stats))
+            fetched = _fetch_arrays(cols)
+            wn = np.asarray(w32)[:n].astype(np.float64)
+            return ([c[:n].astype(np.int64) for c in fetched],
+                    wn, np.asarray(cvec), st)
+    except Exception:
+        LOG.debug('sparse compact fetch failed; full fetch')
+        return None
 
 
 def _compact_fetch(acc, ns, k0):
@@ -1604,7 +2060,8 @@ class DeviceScanStack(object):
         for i, (s, st) in enumerate(zip(scans, staged)):
             pn, profile, caps, ns, total_w = st
             progs, use_pallas = s._staged_programs(st)
-            s._ensure_acc(progs.acc_init, caps, ns)
+            s._ensure_acc(progs.acc_init, caps, ns,
+                          sparse_cap=profile[-1])
             inputs[s._pfx + 'base'] = np.int64(s._acc_batch << 32)
             parts.append((progs.fold, use_pallas))
             # epoch sig covers window origins/host_translate, which

@@ -831,11 +831,44 @@ class VectorScan(object):
     def _defer_final(self):
         if self._defer is None:
             return
+        cols, ws = self._defer
+        flat = self.aggr.flat
+        if flat and any(isinstance(w, int) and abs(w) > 2 ** 53
+                        for w in flat.values()):
+            # exact integer weights beyond f64 in the flat prefix: the
+            # columnar merge would round them; keep the flat dict and
+            # write the deferred tuples into it instead (rare)
+            self._defer_compact()
+            (dcols, dws), self._defer = self._defer, None
+            self._defer_enabled = False
+            self._emit_unique([c[0] for c in dcols], dws[0])
+            return
+        if flat:
+            # tuples written before the defer engaged (small early
+            # batches, MT merges): prepend them as columns — they came
+            # first, so first-occurrence order survives the re-compact
+            pre_cols = [[] for _ in self._breakdown_cols]
+            pre_w = []
+            # dict.code appends unseen values (flat keys may have been
+            # decoded by an MT worker's separate dictionary)
+            encoders = [(col.dict.code if kind == 'str' else None)
+                        for kind, col in self._breakdown_cols]
+            for keys, w in flat.items():
+                for lst, enc, k in zip(pre_cols, encoders, keys):
+                    lst.append(enc(k, k) if enc is not None else k)
+                pre_w.append(w)
+            for c, pre in zip(cols, pre_cols):
+                c.insert(0, np.asarray(pre, dtype=np.int64))
+            ws.insert(0, np.asarray(pre_w, dtype=np.float64))
+            flat.clear()
         self._defer_compact()
         cols, ws = self._defer
         self._defer = None
         self._defer_enabled = False   # direct write from here on
-        self._emit_unique([c[0] for c in cols], ws[0])
+        decoders = [('str', col.dict.values) if kind == 'str'
+                    else ('ord', None)
+                    for kind, col in self._breakdown_cols]
+        self.aggr.set_columnar([c[0] for c in cols], ws[0], decoders)
 
     def finish(self):
         self._defer_final()

@@ -311,15 +311,79 @@ def test_compact_flush_differential(tmp_path, monkeypatch, k0):
                                        engine='auto')
 
     compacted = []
-    orig = mod_ds._compact_fetch
+    orig = mod_ds._compact_program
 
-    def spy(acc, ns, k):
-        r = orig(acc, ns, k)
-        compacted.append(r is not None)
-        return r
-    monkeypatch.setattr(mod_ds, '_compact_fetch', spy)
+    def spy(acc_len, k):
+        # covers the sync flush (_compact_fetch) AND the async
+        # prefetch (_prefetch_flush) — either counts as engagement
+        compacted.append((acc_len, k))
+        return orig(acc_len, k)
+    monkeypatch.setattr(mod_ds, '_compact_program', spy)
     dev_points, dev_counters = _scan(monkeypatch, datafile, qconf,
                                      engine='jax', batch=128)
     assert host_points == dev_points
     assert host_counters == dev_counters
-    assert compacted and all(compacted), 'compact fetch never engaged'
+    assert compacted, 'compact fetch never engaged'
+
+
+@pytest.mark.parametrize('cap0', [1 << 18, 64])
+def test_sparse_device_differential(tmp_path, monkeypatch, cap0):
+    """High-cardinality device path (fused i64 keys sort-merged into a
+    device-resident compacted set): with the dense budget forced tiny,
+    forced-device scans must take the sparse program and match the
+    host engine exactly — points, emission order, counters.  cap0=64
+    forces the pressure guard's flush+grow cycles mid-stream (several
+    epochs merged through the deferred columnar path)."""
+    from dragnet_tpu import engine as mod_engine
+    from dragnet_tpu import device_scan as mod_ds
+    monkeypatch.setattr(mod_engine, 'MAX_DENSE_SEGMENTS', 64)
+    monkeypatch.setattr(mod_ds, 'MAX_DENSE_SEGMENTS', 64)
+    monkeypatch.setattr(mod_ds, 'SPARSE_CAP0', cap0)
+    monkeypatch.setattr(mod_ds, 'SPARSE_CAP_MAX', max(cap0, 1024))
+
+    rng = random.Random(77)
+    lines = _mklines(rng, 900)
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    qconf = {'breakdowns': [{'name': 'host'}, {'name': 'latency'}]}
+
+    host_points, host_counters = _scan(monkeypatch, datafile, qconf,
+                                       engine='vector')
+    dev_points, dev_counters = _scan(monkeypatch, datafile, qconf,
+                                     engine='jax', batch=128)
+    assert host_points == dev_points
+    assert host_counters == dev_counters
+    assert len(dev_points) > 64
+
+
+def test_sparse_device_engages(tmp_path, monkeypatch):
+    """The sparse program must actually process batches (not fall back
+    to the host sparse merge) — asserted via ndevicebatches."""
+    from dragnet_tpu import engine as mod_engine
+    from dragnet_tpu import device_scan as mod_ds
+    from dragnet_tpu.datasource_file import DatasourceFile
+    monkeypatch.setattr(mod_engine, 'MAX_DENSE_SEGMENTS', 64)
+    monkeypatch.setattr(mod_ds, 'MAX_DENSE_SEGMENTS', 64)
+    monkeypatch.setenv('DN_ENGINE', 'jax')
+    monkeypatch.setenv('DN_SCAN_THREADS', '0')
+    monkeypatch.setenv('DN_PARSE_THREADS', '1')
+
+    rng = random.Random(78)
+    lines = [ln for ln in _mklines(rng, 600)
+             if '[1,"two"]' not in ln and '{"x":1}' not in ln]
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile},
+        'ds_filter': None, 'ds_format': 'json',
+    })
+    q = mod_query.query_load(
+        {'breakdowns': [{'name': 'host'}, {'name': 'latency'}]})
+    r = ds.scan(q)
+    ndev = sum(s.counters.get('ndevicebatches', 0)
+               for s in r.pipeline.stages)
+    assert ndev > 0, 'sparse device path never ran'
