@@ -350,8 +350,9 @@ def kernel_bench_extras(datafile):
 # peak-RSS budget for the 10M-record scale leg: results are bounded by
 # output tuples, so memory must not scale with input records (the
 # reference's 250k-record test held 90 MB; 40x the records gets a
-# proportionally tighter per-record bar, not a 40x budget)
-SCALE_RSS_BUDGET_MB = 4096
+# proportionally tighter per-record bar, not a 40x budget).  Measured
+# 305 MB on this rig; the budget leaves ~5x headroom, not 13x.
+SCALE_RSS_BUDGET_MB = 1536
 
 
 def scale_leg(tmpdir, n):
