@@ -387,3 +387,79 @@ def test_sparse_device_engages(tmp_path, monkeypatch):
     ndev = sum(s.counters.get('ndevicebatches', 0)
                for s in r.pipeline.stages)
     assert ndev > 0, 'sparse device path never ran'
+
+
+def test_prefetch_flush_differential(tmp_path, monkeypatch):
+    """The one-time async flush prefetch (issued mid-stream, drained at
+    finish) must be invisible: identical points, order, and counters
+    to the host engine, with host-fallback batches interleaved after
+    the prefetch point."""
+    from dragnet_tpu import device_scan as mod_ds
+    monkeypatch.setattr(mod_ds.DeviceScan, 'PREFETCH_PROGRESS', 0.01)
+    monkeypatch.setattr(mod_ds.DeviceScan, 'COMPACT_MIN_SEGMENTS', 1)
+
+    fired = []
+    orig = mod_ds.DeviceScan._prefetch_flush
+
+    def spy(self):
+        fired.append(self._acc is not None)
+        return orig(self)
+    monkeypatch.setattr(mod_ds.DeviceScan, '_prefetch_flush', spy)
+
+    rng = random.Random(55)
+    lines = _mklines(rng, 900)
+    # edge lines in the tail: host-fallback batches AFTER the prefetch
+    for i, el in enumerate(EDGE_LINES):
+        lines.insert(600 + i * 40, el)
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    qconf = {'breakdowns': [{'name': 'host'},
+                            {'name': 'latency', 'aggr': 'quantize'}]}
+
+    host_points, host_counters = _scan(monkeypatch, datafile, qconf,
+                                       engine='vector')
+    # small reads -> many progress+flush cycles, so the prefetch
+    # trigger sees a live accumulator mid-stream
+    dev_points, dev_counters = scan_points_counters(
+        monkeypatch, datafile, qconf, 'jax', batch=128,
+        read_size=8192, time_field='time',
+        ds_filter={'ne': ['host', 'zzz']})
+    assert fired and any(fired), 'prefetch never fired'
+    assert host_points == dev_points
+    assert host_counters == dev_counters
+
+
+def test_prefetch_flush_sparse_differential(tmp_path, monkeypatch):
+    """Prefetch over the SPARSE accumulator (ub-sized fetch width,
+    narrow-column decode) drained at finish."""
+    from dragnet_tpu import engine as mod_engine
+    from dragnet_tpu import device_scan as mod_ds
+    monkeypatch.setattr(mod_engine, 'MAX_DENSE_SEGMENTS', 64)
+    monkeypatch.setattr(mod_ds, 'MAX_DENSE_SEGMENTS', 64)
+    monkeypatch.setattr(mod_ds.DeviceScan, 'PREFETCH_PROGRESS', 0.01)
+
+    drained = []
+    orig = mod_ds.DeviceScan._drain_pending
+
+    def spy(self):
+        drained.append(len(self._pending_flush))
+        return orig(self)
+    monkeypatch.setattr(mod_ds.DeviceScan, '_drain_pending', spy)
+
+    rng = random.Random(56)
+    lines = _mklines(rng, 900)
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    qconf = {'breakdowns': [{'name': 'host'}, {'name': 'latency'}]}
+
+    host_points, host_counters = _scan(monkeypatch, datafile, qconf,
+                                       engine='vector')
+    dev_points, dev_counters = scan_points_counters(
+        monkeypatch, datafile, qconf, 'jax', batch=128,
+        read_size=8192, time_field='time',
+        ds_filter={'ne': ['host', 'zzz']})
+    assert any(n > 0 for n in drained), 'no prefetched epoch drained'
+    assert host_points == dev_points
+    assert host_counters == dev_counters
