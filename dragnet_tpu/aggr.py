@@ -20,18 +20,14 @@ paths (engine.py and ops/kernels.py) compute identical (key -> weight)
 maps for columnar batches and merge into the same flat structure.
 """
 
+import numpy as np
+
 from . import jsvalues as jsv
-
-
-def _np():
-    import numpy
-    return numpy
 
 
 def _unique_rows_2(a, b):
     """np.unique(return_index/inverse) over 2 int64 columns when their
     fused span overflows int64 (degenerate; row-wise unique instead)."""
-    np = _np()
     mat = np.stack([a, b], axis=1)
     _, first_idx, inv = np.unique(mat, axis=0, return_index=True,
                                   return_inverse=True)
@@ -134,11 +130,11 @@ class Aggregator(object):
         Requires an empty flat map (callers merge any flat prefix into
         the columns first) and replaces it entirely."""
         assert not self.flat and len(cols) == len(self.decomps)
-        self._cols = [_np().asarray(c, dtype='int64') for c in cols]
+        self._cols = [np.asarray(c, dtype='int64') for c in cols]
         if isinstance(weights, list):
             self._cweights = weights     # exact Python numbers
         else:
-            self._cweights = _np().asarray(weights, dtype='float64')
+            self._cweights = np.asarray(weights, dtype='float64')
         self._cdec = decoders
 
     # results at least this large take the columnar order/decode even
@@ -150,7 +146,6 @@ class Aggregator(object):
     def _flat_to_columnar(self):
         """Convert the flat map to columns (first-occurrence order is
         the dict's insertion order) so points()/rows() vectorize."""
-        np = _np()
         cols = [[] for _ in self.decomps]
         encs = []
         decoders = []
@@ -189,7 +184,6 @@ class Aggregator(object):
         within-parent arrival rank is the first occurrence index of
         the (parent-group, code) pair in arrival order; a stable
         lexsort over all levels reproduces the nested enumeration."""
-        np = _np()
         n = len(self._cweights)
         levels = []   # (numeric-class, sort-value) per level
         gid = np.zeros(n, dtype=np.int64)
@@ -245,7 +239,6 @@ class Aggregator(object):
         return np.lexsort(tuple(seq))
 
     def _columnar_points(self, as_rows):
-        np = _np()
         order = self._columnar_order()
         n = len(order)
         cols_out = []

@@ -38,6 +38,7 @@ buffer so insertion order survives.  Differential tests pin
 DeviceScan == VectorScan == StreamScan.
 """
 
+import collections
 import threading
 import time
 
@@ -103,8 +104,6 @@ def _rate_field(r):
 # structure of the program (see _program_key)
 _PROGRAM_CACHE = {}
 _ACC_INIT_CACHE = {}
-
-import collections
 
 # run_scatter/run_pallas are jitted (args, acc) -> acc callables; fold
 # is the UNJITTED (args, acc, use_pallas) body DeviceScanStack composes
@@ -433,12 +432,7 @@ class DeviceScan(VectorScan):
                 out = _compact_program(int(acc[0].shape[0]), k)(acc)
             else:
                 return    # small fetch: nothing worth overlapping
-            for a in out:
-                if hasattr(a, 'copy_to_host_async'):
-                    try:
-                        a.copy_to_host_async()
-                    except Exception:
-                        pass
+            _issue_async(out)
         except Exception:
             LOG.debug('flush prefetch failed; staying synchronous')
             return
@@ -1245,7 +1239,6 @@ class DeviceScan(VectorScan):
         # a power of two), and by then the live _KeyPlan objects may
         # have mutated (window lo, host_translate) — the frozen copies
         # keep every retrace faithful to this program's cache key.
-        import collections
         _P = collections.namedtuple(
             '_P', 'kind name field step lo host_translate')
         plans = [_P(p.kind, p.name, p.field, p.step, p.lo,
@@ -1687,35 +1680,22 @@ class DeviceScan(VectorScan):
             self._flush_sparse(acc, meta, sparse_ub)
             return
 
-        segs = wsum = None
-        if meta['cols'] and meta['ns'] >= self.COMPACT_MIN_SEGMENTS:
+        if not meta['cols']:
+            _issue_async(acc)
+            self._emit_counters(np.asarray(acc[2]))
+            self.aggr.write_key(
+                (), self._weight(float(np.asarray(acc[0])[0])))
+            return
+
+        segs = wsum = cvec = None
+        if meta['ns'] >= self.COMPACT_MIN_SEGMENTS:
             fetched = _compact_fetch(acc, meta['ns'], self.COMPACT_K)
             if fetched is not None:
                 segs, wsum, cvec = fetched
                 self.aggr.stage.bump_hidden('ncompactflush', 1)
-
         if segs is None:
-            for a in acc:
-                if hasattr(a, 'copy_to_host_async'):
-                    try:
-                        a.copy_to_host_async()
-                    except Exception:
-                        pass
-            dense = np.asarray(acc[0])
-            first = np.asarray(acc[1])
-            cvec = np.asarray(acc[2])
-
+            segs, wsum, cvec = _dense_full_result(acc)
         self._emit_counters(cvec)
-        if not meta['cols']:
-            self.aggr.write_key((), self._weight(float(dense[0])))
-            return
-        if segs is None:
-            occurred = np.nonzero(first < I64MAX)[0]
-            if len(occurred) == 0:
-                return
-            order = np.argsort(first[occurred], kind='stable')
-            segs = occurred[order]
-            wsum = dense[segs].astype(np.float64)
         # global codes for the shared emit path: device string codes
         # are already engine-dictionary codes; bucket codes offset
         # by the window origin give raw ordinals
@@ -1915,12 +1895,7 @@ def _sparse_fetch(acc, k0, caps):
         while True:
             cols, w32, wof, cvec, stats = \
                 _sparse_program(cap, k, tuple(caps))(acc)
-            for a in list(cols) + [w32, cvec, stats]:
-                if hasattr(a, 'copy_to_host_async'):
-                    try:
-                        a.copy_to_host_async()
-                    except Exception:
-                        pass
+            _issue_async(list(cols) + [w32, cvec, stats])
             st = np.asarray(stats)
             n = int(st[0])
             if n > k:
@@ -1953,12 +1928,7 @@ def _compact_fetch(acc, ns, k0):
     try:
         while True:
             cnt, segs, dense, cvec = _compact_program(acc_len, k)(acc)
-            for a in (cnt, segs, dense, cvec):
-                if hasattr(a, 'copy_to_host_async'):
-                    try:
-                        a.copy_to_host_async()
-                    except Exception:
-                        pass
+            _issue_async((cnt, segs, dense, cvec))
             n = int(np.asarray(cnt))
             if n <= k:
                 segs = np.asarray(segs)[:n].astype(np.int64)
