@@ -861,7 +861,10 @@ class VectorScan(object):
                 c.insert(0, np.asarray(pre, dtype=np.int64))
             ws.insert(0, np.asarray(pre_w, dtype=np.float64))
             flat.clear()
-        self._defer_compact()
+        if len(ws) > 1:
+            # a single chunk is one batch's (or one device epoch's)
+            # already-unique tuples: nothing to merge
+            self._defer_compact()
         cols, ws = self._defer
         self._defer = None
         self._defer_enabled = False   # direct write from here on
