@@ -1,0 +1,63 @@
+"""Kernel-resident microbenchmark harness (dragnet_tpu/devbench.py):
+the bench's chip-level legs must keep working — a silent breakage here
+loses the round's device measurements."""
+
+import json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import native as mod_native      # noqa: E402
+from dragnet_tpu.ops import get_jax, backend_ready  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    mod_native.get_lib() is None or get_jax() is None or
+    not backend_ready(),
+    reason='native parser or jax unavailable')
+
+
+def _write_data(path, n):
+    rng = random.Random(3)
+    with open(path, 'w') as f:
+        for _ in range(n):
+            f.write(json.dumps({
+                'host': 'h%d' % rng.randrange(8),
+                'latency': rng.choice([1, 5, 40, 900]),
+                'code': rng.choice([200, 404, 500]),
+            }) + '\n')
+
+
+def test_kernel_bench_fields(tmp_path):
+    from dragnet_tpu import devbench
+    datafile = str(tmp_path / 'd.log')
+    _write_data(datafile, 600)
+    r = devbench.kernel_bench(
+        datafile,
+        {'breakdowns': [{'name': 'host'},
+                        {'name': 'latency', 'aggr': 'quantize'}],
+         'filter': {'ne': ['code', 500]}},
+        iters=3, max_records=512)
+    assert r is not None
+    assert r['records'] == 512
+    assert r['segments'] >= 8
+    assert r['kernel_records_per_sec'] > 0
+    assert r['h2d_gb_per_sec'] > 0
+    assert r['h2d_bytes_per_record'] > 0
+    assert r['d2h_mb_per_sec'] > 0
+    assert r['platform']
+
+
+def test_kernel_bench_respects_max_records(tmp_path):
+    from dragnet_tpu import devbench
+    datafile = str(tmp_path / 'd.log')
+    _write_data(datafile, 300)
+    r = devbench.kernel_bench(
+        datafile, {'breakdowns': [{'name': 'host'}]},
+        iters=2, max_records=128)
+    assert r is not None
+    assert r['records'] == 128
