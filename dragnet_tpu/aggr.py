@@ -110,6 +110,11 @@ class Aggregator(object):
         self._add(tuple(keys), value)
 
     def _add(self, keys, value):
+        if self._cols is not None:
+            # the columnar result is final; a write after conversion
+            # would be silently invisible to points()/rows()
+            raise RuntimeError(
+                'Aggregator.write after columnar conversion')
         self.nrecords += 1
         if not self.decomps:
             self.total += value
