@@ -290,11 +290,15 @@ def index_query_bench(tmpdir):
     full_p50, full_p95 = measure(q(), 11)
     win_p50, win_p95 = measure(
         q('2014-06-01', '2014-07-01'), 11)
+    prior_conc = os.environ.get('DN_QUERY_CONCURRENCY')
     os.environ['DN_QUERY_CONCURRENCY'] = '1'
     try:
         seq_p50, _ = measure(q(), 5)
     finally:
-        os.environ.pop('DN_QUERY_CONCURRENCY', None)
+        if prior_conc is None:
+            os.environ.pop('DN_QUERY_CONCURRENCY', None)
+        else:
+            os.environ['DN_QUERY_CONCURRENCY'] = prior_conc
     shutil.rmtree(idx, ignore_errors=True)
     os.unlink(datafile)
     return {

@@ -251,6 +251,10 @@ class Aggregator(object):
                                     self.decomps):
             cc = codes[order]
             if dec[0] == 'ord':
+                if as_rows:
+                    # rows carry ordinal form, not bucket-min
+                    cols_out.append(cc.tolist())
+                    continue
                 # bucket-min per unique ordinal (few), mapped
                 bz = self.bucketizers[name]
                 uniq = np.unique(cc)
@@ -280,15 +284,8 @@ class Aggregator(object):
         if as_rows:
             if not cols_out:
                 return [list(t) for t in zip(weights)]
-            # rows carry ordinal/key form, not bucket-min: decode ords
-            # back from the sorted codes
-            raw = []
-            for codes, dec in zip(self._cols, self._cdec):
-                cc = codes[order]
-                raw.append(cc.tolist() if dec[0] == 'ord'
-                           else np.asarray(dec[1],
-                                           dtype=object)[cc].tolist())
-            return [list(t) + [w] for t, w in zip(zip(*raw), weights)]
+            return [list(t) + [w]
+                    for t, w in zip(zip(*cols_out), weights)]
         names = self.decomps
         # literal dict construction (dict(zip(...)) costs ~2x here),
         # and tuples built by a second zip pass rather than inside the

@@ -456,6 +456,7 @@ class DeviceScan(VectorScan):
                 st = np.asarray(stats)
                 n = int(st[0])
                 k = int(cols[0].shape[0])
+                compacted = True
                 if n > k or bool(np.asarray(wof)):
                     # ub bound failed or i32 weight overflow: refetch
                     fetched = _sparse_fetch(acc, _pow2(max(n, 1)),
@@ -463,6 +464,7 @@ class DeviceScan(VectorScan):
                     if fetched is None:   # device fetch error: full
                         fetched = _sparse_full_result(acc,
                                                       meta['caps'])
+                        compacted = False
                     cols_np, wsumf, cvec_np, st = fetched
                 else:
                     cols_np = [c[:n].astype(np.int64)
@@ -473,24 +475,27 @@ class DeviceScan(VectorScan):
                     raise RuntimeError(
                         'device sparse aggregation overflowed its '
                         'resident set (cap=%d)' % cap)
-                self.aggr.stage.bump_hidden('ncompactflush', 1)
+                if compacted:
+                    self.aggr.stage.bump_hidden('ncompactflush', 1)
                 self._emit_counters(cvec_np)
                 self._emit_cols(meta, cols_np, wsumf)
             else:
                 cnt, segs, dense, cvec = out
                 n = int(np.asarray(cnt))
                 k = int(segs.shape[0])
+                compacted = True
                 if n > k:
-                    fetched = _compact_fetch(acc, meta['ns'],
-                                             _pow2(n))
+                    fetched = _compact_fetch(acc, _pow2(n))
                     if fetched is None:   # device fetch error: full
                         fetched = _dense_full_result(acc)
+                        compacted = False
                     segs_np, wsumf, cvec_np = fetched
                 else:
                     segs_np = np.asarray(segs)[:n].astype(np.int64)
                     wsumf = np.asarray(dense)[:n].astype(np.float64)
                     cvec_np = np.asarray(cvec)
-                self.aggr.stage.bump_hidden('ncompactflush', 1)
+                if compacted:
+                    self.aggr.stage.bump_hidden('ncompactflush', 1)
                 self._emit_counters(cvec_np)
                 self._decode_emit(meta, segs_np, wsumf)
 
@@ -1015,7 +1020,11 @@ class DeviceScan(VectorScan):
             # known failure mode was exactly this workload
             # (README.md:668-681).  Excluded under a mesh (a sparse set
             # has no psum merge) and when the fused key would overflow.
-            if self._device_mesh() is not None or ns > (1 << 62):
+            # per-column codes are computed in i32 on device (and
+            # fetched dtype-narrowed), so any single cap beyond 2^31
+            # would wrap — host path instead
+            if self._device_mesh() is not None or ns > (1 << 62) or \
+                    max(new_caps) > (1 << 31):
                 self._disabled = True
                 return None
             sparse = True
@@ -1689,7 +1698,7 @@ class DeviceScan(VectorScan):
 
         segs = wsum = cvec = None
         if meta['ns'] >= self.COMPACT_MIN_SEGMENTS:
-            fetched = _compact_fetch(acc, meta['ns'], self.COMPACT_K)
+            fetched = _compact_fetch(acc, self.COMPACT_K)
             if fetched is not None:
                 segs, wsum, cvec = fetched
                 self.aggr.stage.bump_hidden('ncompactflush', 1)
@@ -1848,7 +1857,9 @@ def _decode_fused(keys, caps):
 
 def _issue_async(arrays):
     for a in arrays:
-        if hasattr(a, 'copy_to_host_async'):
+        if isinstance(a, (tuple, list)):
+            _issue_async(a)     # e.g. the sparse program's cols tuple
+        elif hasattr(a, 'copy_to_host_async'):
             try:
                 a.copy_to_host_async()
             except Exception:
@@ -1899,8 +1910,12 @@ def _sparse_fetch(acc, k0, caps):
             st = np.asarray(stats)
             n = int(st[0])
             if n > k:
-                k = min(cap, _pow2(n))
-                continue
+                if k < cap:
+                    k = min(cap, _pow2(n))
+                    continue
+                # n > capacity: genuine overflow — fetch what exists
+                # and let the caller's stats[1] check raise loudly
+                n = k
             if bool(np.asarray(wof)):
                 keys, wsum, cvec, stats = \
                     _sparse_program_full(cap, k)(acc)
@@ -1917,7 +1932,7 @@ def _sparse_fetch(acc, k0, caps):
         return None
 
 
-def _compact_fetch(acc, ns, k0):
+def _compact_fetch(acc, k0):
     """Device-side compaction of a flush fetch: returns
     (segs i64[cnt] in first-occurrence order, weights f64[cnt], cvec)
     fetching O(occurred) bytes instead of O(ns), or None to take the

@@ -463,3 +463,35 @@ def test_prefetch_flush_sparse_differential(tmp_path, monkeypatch):
     assert any(n > 0 for n in drained), 'no prefetched epoch drained'
     assert host_points == dev_points
     assert host_counters == dev_counters
+
+
+def test_sparse_cap_overflow_falls_back(tmp_path, monkeypatch):
+    """A single bucketized column whose ordinal span exceeds 2^31
+    cannot use the device (per-record codes are computed in i32): the
+    scan must fall back to the host engine with identical results
+    rather than wrapping key codes."""
+    import json as _json
+    from dragnet_tpu import engine as mod_engine
+    from dragnet_tpu import device_scan as mod_ds
+    monkeypatch.setattr(mod_engine, 'MAX_DENSE_SEGMENTS', 32)
+    monkeypatch.setattr(mod_ds, 'MAX_DENSE_SEGMENTS', 32)
+
+    rng = random.Random(91)
+    datafile = str(tmp_path / 'data.log')
+    with open(datafile, 'w') as f:
+        for i in range(300):
+            # exact-i32 values spanning ~4.2e9 -> lquantize(step=1)
+            # ordinal span > 2^31
+            f.write(_json.dumps({
+                'v': rng.choice([-2100000000, -5, 0, 7,
+                                 2100000000]) + i,
+                'host': 'h%d' % (i % 7),
+            }) + '\n')
+    qconf = {'breakdowns': [{'name': 'v', 'aggr': 'lquantize',
+                             'step': 1}]}
+    host_points, host_counters = _scan(monkeypatch, datafile, qconf,
+                                       engine='vector')
+    dev_points, dev_counters = _scan(monkeypatch, datafile, qconf,
+                                     engine='jax', batch=64)
+    assert host_points == dev_points
+    assert host_counters == dev_counters
