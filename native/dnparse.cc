@@ -313,10 +313,14 @@ bool parse_iso_date(const char* s, size_t len, int64_t* ms_out) {
 // ---------------------------------------------------------------------
 // JSON scanning
 
-// SWAR scan: advance to the first byte that is '"', '\\', or a raw
-// control char (< 0x20), 8 bytes per step.  These are the only bytes a
-// JSON string scanner must act on; everything else is literal content.
-static inline const char* scan_plain(const char* p, const char* end) {
+// Scan: advance to the first byte that is '"', '\\', or a raw
+// control char (< 0x20).  These are the only bytes a JSON string
+// scanner must act on; everything else is literal content.  SWAR
+// (8 bytes/step) baseline with an AVX2 (32 bytes/step) variant
+// dispatched at runtime — the library is built on the host it runs
+// on, but the binary stays loadable on machines without AVX2.
+static inline const char* scan_plain_swar(const char* p,
+                                          const char* end) {
   constexpr uint64_t kOnes = 0x0101010101010101ull;
   constexpr uint64_t kHigh = 0x8080808080808080ull;
   while (end - p >= 8) {
@@ -340,6 +344,43 @@ static inline const char* scan_plain(const char* p, const char* end) {
   }
   return end;
 }
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+__attribute__((target("avx2")))
+static const char* scan_plain_avx2(const char* p, const char* end) {
+  const __m256i vq = _mm256_set1_epi8('"');
+  const __m256i vb = _mm256_set1_epi8('\\');
+  const __m256i vlim = _mm256_set1_epi8(0x1F);
+  while (end - p >= 32) {
+    __m256i w = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(p));
+    __m256i hq = _mm256_cmpeq_epi8(w, vq);
+    __m256i hb = _mm256_cmpeq_epi8(w, vb);
+    // unsigned (byte < 0x20)  <=>  min(byte, 0x1F) == byte
+    __m256i hc = _mm256_cmpeq_epi8(_mm256_min_epu8(w, vlim), w);
+    unsigned mask = static_cast<unsigned>(_mm256_movemask_epi8(
+        _mm256_or_si256(hq, _mm256_or_si256(hb, hc))));
+    if (mask)
+      return p + __builtin_ctz(mask);
+    p += 32;
+  }
+  return scan_plain_swar(p, end);
+}
+
+static const bool kHaveAvx2 =
+    (__builtin_cpu_init(), __builtin_cpu_supports("avx2"));
+
+static inline const char* scan_plain(const char* p, const char* end) {
+  if (kHaveAvx2)
+    return scan_plain_avx2(p, end);
+  return scan_plain_swar(p, end);
+}
+#else
+static inline const char* scan_plain(const char* p, const char* end) {
+  return scan_plain_swar(p, end);
+}
+#endif
 
 struct Scanner {
   const char* p;
