@@ -643,7 +643,13 @@ class DatasourceFile(object):
                     lambda snap: NativeColumns(
                         _RemappedParser(snap, remap) if skinner
                         else snap),
-                    lambda snap, n: _batch_weights(skinner, snap, n))
+                    lambda snap, n: _batch_weights(skinner, snap, n),
+                    # production passes the shared ds-filter mask as a
+                    # non-None alive; the replay must match that shape
+                    # or the staged profile misses the program cache
+                    make_alive=(
+                        (lambda n: np.ones(n, dtype=bool))
+                        if filter is not None else None))
 
             self._takeover_stream(
                 files, parser, BATCH_SIZE, progress_fn, new_executor,

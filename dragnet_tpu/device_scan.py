@@ -2112,10 +2112,16 @@ class _ShadowProbe(object):
 
     COLLECT = 5      # 1 warmup + 4 measured batches
 
-    def __init__(self, make_scans, make_provider, make_weights):
+    def __init__(self, make_scans, make_provider, make_weights,
+                 make_alive=None):
         self.make_scans = make_scans
         self.make_provider = make_provider
         self.make_weights = make_weights
+        # production may pass a non-None alive mask (the build path's
+        # shared datasource-filter eval); the replay must match, or the
+        # staged profile (gen_alive) — and so the program cache key —
+        # differs from what the takeover will run
+        self.make_alive = make_alive or (lambda n: None)
         self.items = []
         self.rate = None
         self.failed = False
@@ -2155,12 +2161,22 @@ class _ShadowProbe(object):
                 # scratch scans: their results are discarded by design,
                 # so an unflushed accumulator here is not lost work
                 _SCAN_LEAKS.untrack(s)
+            # multi-metric auditions replay through the combined
+            # program — the thing production runs after a build
+            # takeover — so the measured rate reflects the stack and
+            # the prewarmed _STACK_CACHE, not N per-scan programs the
+            # takeover would never execute
+            stack = make_stack(scans)
 
             def run_one(snap, n):
                 provider = self.make_provider(snap)
                 weights = self.make_weights(snap, n)
+                alive = self.make_alive(n)
+                if stack is not None:
+                    return stack._process_device(provider, weights,
+                                                 alive)
                 for s in scans:
-                    if not s._try_device(provider, weights, None):
+                    if not s._try_device(provider, weights, alive):
                         return False
                 return True
 
@@ -2223,12 +2239,14 @@ class AutoDeviceScan(DeviceScan):
     # near-tie is not worth the transition)
     SHADOW_MARGIN = 1.15
 
-    def enable_shadow(self, make_scans, make_provider, make_weights):
+    def enable_shadow(self, make_scans, make_provider, make_weights,
+                      make_alive=None):
         """MT-path integration: before the device may take the stream,
         it must win an audition on copies of live batches (fed via
         shadow_feed) against the observed host rate — so a host engine
         that is already faster is never disturbed at all."""
-        self._shadow_ctx = (make_scans, make_provider, make_weights)
+        self._shadow_ctx = (make_scans, make_provider, make_weights,
+                            make_alive)
 
     def shadow_feed(self, snap, n):
         sp = self._shadow

@@ -127,6 +127,86 @@ class ParserSnapshot(object):
     def dictionary(self, path):
         return self._dicts[path]
 
+    # -- device-path accessors (lazy; only the shadow audition's device
+    # staging calls these — worker host scans never do).  Semantics
+    # mirror NativeParser's native one-pass accessors exactly, so a
+    # program staged from a snapshot has the SAME upload profile (and
+    # hits the same compiled-program cache entries) as the production
+    # program staged from the live parser — without this, auditions
+    # traced a use_dstats=False variant production never runs and paid
+    # a full compile inside their measurement window.
+
+    def field_stats(self, path):
+        cache = getattr(self, '_fstats', None)
+        if cache is None:
+            cache = self._fstats = {}
+        st = cache.get(path)
+        if st is None:
+            import numpy as np
+            from . import native as mod_native
+            tags, nums, strcodes = self._cols[path]
+            m = (tags == mod_native.TAG_INT) | \
+                (tags == mod_native.TAG_NUMBER)
+            nnum = int(m.sum())
+            nstr = int((tags == mod_native.TAG_STRING).sum())
+            narr = int((tags == mod_native.TAG_ARRAY).sum())
+            i32ok = True
+            nmn = nmx = 0.0
+            if nnum:
+                nm = nums[m]
+                nmn = float(nm.min())
+                nmx = float(nm.max())
+                i32ok = bool(np.all(np.isfinite(nm)) and
+                             np.all(nm == np.floor(nm)) and
+                             nmn >= -(2 ** 31) and
+                             nmx <= 2 ** 31 - 1)
+            st = (narr, i32ok, nmn, nmx, nnum, nstr)
+            cache[path] = st
+        return st
+
+    def tags_col(self, path):
+        return self._cols[path][0]
+
+    def strcodes_col(self, path):
+        return self._cols[path][2]
+
+    def nums_i32(self, path):
+        import numpy as np
+        from . import native as mod_native
+        tags, nums, _ = self._cols[path]
+        m = (tags == mod_native.TAG_INT) | \
+            (tags == mod_native.TAG_NUMBER)
+        # valid only after field_stats reported all_nums_i32, same
+        # contract as the native accessor
+        return np.where(m, nums, 0.0).astype(np.int64).astype(np.int32)
+
+    def date_stats(self, path):
+        d = self._dates.get(path)
+        if d is None:
+            return None
+        import numpy as np
+        secs, err = d
+        ok = err == 0
+        n_ok = int(ok.sum())
+        if n_ok:
+            so = secs[ok]
+            all_i32 = bool(np.all(np.isfinite(so)) and
+                           np.all(so == np.floor(so)) and
+                           so.min() >= -(2 ** 31) and
+                           so.max() <= 2 ** 31 - 1)
+        else:
+            all_i32 = True
+        return (all_i32, n_ok)
+
+    def date_i32(self, path):
+        import numpy as np
+        secs, err = self._dates[path]
+        return np.where(err == 0, secs,
+                        0.0).astype(np.int64).astype(np.int32)
+
+    def date_err(self, path):
+        return self._dates[path][1]
+
 
 class BatchRecorder(object):
     """Aggregator stand-in for worker scans: records write_key calls in

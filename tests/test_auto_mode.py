@@ -216,3 +216,80 @@ def test_nonmt_async_escalation(datafile, expected, monkeypatch):
         if s._escalated:
             break
     assert s._escalated
+
+
+def test_auto_build_takeover_uses_stack(tmp_path, monkeypatch,
+                                        datafile):
+    """An auto-mode BUILD whose device wins the audition must fold the
+    post-takeover batches through the combined multi-metric program
+    (DeviceScanStack), with index artifacts byte-identical to the host
+    build."""
+    from dragnet_tpu import native as mod_native
+    if mod_native.get_lib() is None:
+        pytest.skip('native parser unavailable')
+    import dragnet_tpu.engine as eng
+    monkeypatch.setenv('DN_SCAN_THREADS', '2')
+    monkeypatch.setenv('DN_READ_SIZE', '65536')
+    monkeypatch.setattr(device_scan, 'BATCH_SIZE', SMALL_BATCH)
+    monkeypatch.setattr(eng, 'BATCH_SIZE', SMALL_BATCH)
+
+    metrics = [mod_query.metric_deserialize(m) for m in [
+        {'name': 'a', 'breakdowns': [
+            {'name': 'timestamp', 'field': 'time', 'date': '',
+             'aggr': 'lquantize', 'step': 86400},
+            {'name': 'host', 'field': 'host'}]},
+        {'name': 'b', 'breakdowns': [
+            {'name': 'timestamp', 'field': 'time', 'date': '',
+             'aggr': 'lquantize', 'step': 86400},
+            {'name': 'latency', 'field': 'latency',
+             'aggr': 'quantize'}]},
+    ]]
+
+    def build(engine, sub, cls=None):
+        if engine is None:
+            monkeypatch.delenv('DN_ENGINE', raising=False)
+        else:
+            monkeypatch.setenv('DN_ENGINE', engine)
+        # scope the class override separately: monkeypatch.undo()
+        # would also revert BATCH_SIZE/COLLECT and starve later
+        # attempts
+        local = pytest.MonkeyPatch()
+        if cls is not None:
+            local.setattr(DatasourceFile, '_vector_scan_cls',
+                          lambda self: cls)
+        idx = str(tmp_path / sub)
+        bc = {'path': datafile, 'indexPath': idx, 'timeField': 'time'}
+        ds = DatasourceFile({'ds_backend': 'file',
+                             'ds_backend_config': bc,
+                             'ds_filter': None, 'ds_format': 'json'})
+        try:
+            r = ds.build(metrics, 'day')
+        finally:
+            local.undo()
+        tree = {}
+        for root, dirs, files in os.walk(idx):
+            for fn in sorted(files):
+                p = os.path.join(root, fn)
+                with open(p, 'rb') as f:
+                    tree[os.path.relpath(p, idx)] = f.read()
+        stacked = sum(s.counters.get('nstackedbatches', 0)
+                      for s in r.pipeline.stages)
+        return tree, stacked
+
+    host_tree, _ = build('vector', 'ih')
+    # pre-warm device programs so the audition concludes in-stream,
+    # and shorten the audition itself (2 scratch scans to replay)
+    from dragnet_tpu import ops
+    ops.backend_ready()
+    build('jax', 'iw')
+    monkeypatch.setattr(device_scan._ShadowProbe, 'COLLECT', 2)
+
+    stacked = 0
+    for attempt in range(8):
+        dev_tree, stacked = build(None, 'ia%d' % attempt, cls=_Eager)
+        assert dev_tree.keys() == host_tree.keys()
+        for rel in host_tree:
+            assert host_tree[rel] == dev_tree[rel], rel
+        if stacked:
+            break
+    assert stacked > 0, 'stack never engaged after auto takeover'
