@@ -237,3 +237,56 @@ def test_cluster_dry_run_plan(tmp_path, capsys):
     assert parsed['backend'] == 'cluster'
     assert 'partition' not in parsed      # moved to the Inputs section
     assert inputs.splitlines() == [str(datadir / 'a.log')]
+
+
+def test_cluster_highcard_falls_back_to_host_sparse(tmp_path,
+                                                    monkeypatch):
+    """Key spaces beyond the dense budget are excluded from the mesh
+    program (a sparse set has no psum merge): the cluster scan must
+    fall back to the host sparse merge with results identical to the
+    host engine — the bounded-memory discipline survives the
+    distributed backend."""
+    import json
+    from dragnet_tpu import query as mod_query
+    from dragnet_tpu import native as mod_native
+    from dragnet_tpu.parallel import cluster
+    import dragnet_tpu.engine as eng
+    from dragnet_tpu import device_scan
+
+    if mod_native.get_lib() is None:
+        pytest.skip('native parser unavailable')
+
+    monkeypatch.setattr(eng, 'MAX_DENSE_SEGMENTS', 64)
+    monkeypatch.setattr(device_scan, 'MAX_DENSE_SEGMENTS', 64)
+
+    datadir = tmp_path / 'data'
+    datadir.mkdir()
+    rng = random.Random(17)
+    with open(datadir / 'a.log', 'w') as f:
+        for i in range(1500):
+            f.write(json.dumps({
+                'host': 'h%d' % rng.randrange(60),
+                'latency': rng.randrange(0, 4000),
+            }) + '\n')
+
+    dsconfig = {
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': str(datadir)},
+        'ds_filter': None,
+        'ds_format': 'json',
+    }
+    qconf = {'breakdowns': [{'name': 'host'}, {'name': 'latency'}]}
+
+    monkeypatch.setenv('DN_ENGINE', 'host')
+    expected = cluster.DatasourceCluster(dsconfig).scan(
+        mod_query.query_load(qconf)).points
+    monkeypatch.delenv('DN_ENGINE', raising=False)
+
+    monkeypatch.setattr(eng, 'BATCH_SIZE', 256)
+    monkeypatch.setattr(device_scan, 'BATCH_SIZE', 256)
+    monkeypatch.setenv('DN_READ_SIZE', '65536')
+    monkeypatch.setenv('DN_SCAN_THREADS', '0')
+    r = cluster.DatasourceCluster(dsconfig).scan(
+        mod_query.query_load(qconf))
+    assert r.points == expected
+    assert len(r.points) > 64
