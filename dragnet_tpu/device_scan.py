@@ -403,13 +403,16 @@ class DeviceScan(VectorScan):
         """Stream-progress hook (the file datasource reports bytes
         consumed vs total): lets auto mode estimate remaining work
         before committing to a device switch, and triggers the one-time
-        async flush prefetch late in the stream."""
+        async flush prefetch late in the stream (DN_PREFETCH=0
+        disables — operational escape hatch)."""
         self._progress = (bytes_done, bytes_total)
         if not self._prefetched and self._acc is not None and \
                 bytes_total > 0 and \
                 bytes_done >= self.PREFETCH_PROGRESS * bytes_total:
             self._prefetched = True
-            self._prefetch_flush()
+            import os
+            if os.environ.get('DN_PREFETCH', '1') != '0':
+                self._prefetch_flush()
 
     def _prefetch_flush(self):
         """Compact the current epoch on device and issue its fetch
@@ -2092,7 +2095,11 @@ class DeviceScanStack(object):
 def make_stack(scanners):
     """A DeviceScanStack when the scanner set supports it (>=2 device
     scans outside a mesh), else None (callers keep the per-scan
-    loop)."""
+    loop).  DN_STACK=0 disables stacking (operational escape hatch:
+    per-scan programs still run)."""
+    import os
+    if os.environ.get('DN_STACK', '1') == '0':
+        return None
     if len(scanners) < 2:
         return None
     if not all(isinstance(s, DeviceScan) and

@@ -167,3 +167,23 @@ def test_stacked_index_scan_points_identical(tmp_path, monkeypatch):
 
     assert [(f, v) for f, v in host.points] == \
         [(f, v) for f, v in dev.points]
+
+
+def test_stack_disable_env(tmp_path, monkeypatch):
+    """DN_STACK=0 keeps the per-scan device programs (results
+    identical) — the operational escape hatch for plugins that
+    misbehave under the combined program."""
+    datafile = tmp_path / 'data.log'
+    _write_data(datafile, 1200)
+
+    _, s_on = _build(monkeypatch, datafile, tmp_path / 'i1', 'jax')
+    assert s_on > 0
+    monkeypatch.setenv('DN_STACK', '0')
+    _, s_off = _build(monkeypatch, datafile, tmp_path / 'i2', 'jax')
+    assert s_off == 0
+
+    t1 = _tree_bytes(tmp_path / 'i1')
+    t2 = _tree_bytes(tmp_path / 'i2')
+    assert t1.keys() == t2.keys()
+    for rel in t1:
+        assert t1[rel] == t2[rel], rel
