@@ -61,3 +61,21 @@ def test_kernel_bench_respects_max_records(tmp_path):
         iters=2, max_records=128)
     assert r is not None
     assert r['records'] == 128
+
+
+def test_kernel_bench_records_profiler_trace(tmp_path, monkeypatch):
+    """DN_BENCH_TRACE=dir wraps the kernel-resident loop in a
+    jax.profiler trace; the trace artifact must actually appear."""
+    from dragnet_tpu import devbench
+    datafile = str(tmp_path / 'd.log')
+    _write_data(datafile, 400)
+    tracedir = str(tmp_path / 'trace')
+    monkeypatch.setenv('DN_BENCH_TRACE', tracedir)
+    r = devbench.kernel_bench(
+        datafile, {'breakdowns': [{'name': 'host'}]},
+        iters=2, max_records=128)
+    assert r is not None
+    found = []
+    for root, dirs, files in os.walk(tracedir):
+        found.extend(files)
+    assert found, 'no profiler trace artifacts written'
