@@ -1,0 +1,39 @@
+"""bench.py's device-alive gate: a wedged device plugin (every op
+hanging, observed on the tunneled rig mid-round-5) must cost one
+bounded probe, not a hung benchmark."""
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench                                   # noqa: E402
+from dragnet_tpu import ops                    # noqa: E402
+
+
+def test_device_alive_times_out_on_hang(monkeypatch):
+    def hang():
+        time.sleep(300)
+    monkeypatch.setattr(ops, 'backend_ready', hang)
+    t0 = time.monotonic()
+    assert bench.device_alive(timeout_s=1) is False
+    assert time.monotonic() - t0 < 10
+
+
+def test_device_alive_false_on_error(monkeypatch):
+    def boom():
+        raise RuntimeError('no backend')
+    monkeypatch.setattr(ops, 'backend_ready', boom)
+    assert bench.device_alive(timeout_s=30) is False
+
+
+def test_device_alive_true_on_working_backend():
+    if ops.get_jax() is None or not ops.backend_ready():
+        pytest.skip('jax unavailable')
+    # the suite runs on the CPU backend (conftest): a real, working
+    # device_put round trip
+    assert bench.device_alive(timeout_s=180) is True
