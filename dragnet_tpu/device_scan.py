@@ -109,7 +109,7 @@ _ACC_INIT_CACHE = {}
 # is the UNJITTED (args, acc, use_pallas) body DeviceScanStack composes
 # into one combined jit across metrics
 _Programs = collections.namedtuple(
-    '_Programs', 'run_scatter run_pallas acc_init fold have_pallas')
+    '_Programs', 'run_scatter run_pallas acc_init fold')
 
 # combined multi-metric programs (DeviceScanStack), keyed by the tuple
 # of member program keys + pallas flags
@@ -1626,13 +1626,11 @@ class DeviceScan(VectorScan):
                 if len(_ACC_INIT_CACHE) >= 64:
                     _ACC_INIT_CACHE.pop(next(iter(_ACC_INIT_CACHE)))
                 _ACC_INIT_CACHE[init_key] = acc_init
-            return _Programs(run_scatter, None, acc_init, fold_u,
-                             False)
+            return _Programs(run_scatter, None, acc_init, fold_u)
 
         run_scatter = jax.jit(lambda args, acc: fold(args, acc, False))
         run_pallas = None
-        have_pallas = pk.pallas_ok(ns) and pk.available()
-        if have_pallas:
+        if pk.pallas_ok(ns) and pk.available():
             run_pallas = jax.jit(lambda args, acc: fold(args, acc, True))
 
         init_key = (acc_ns, ncnt)
@@ -1648,8 +1646,7 @@ class DeviceScan(VectorScan):
             if len(_ACC_INIT_CACHE) >= 64:
                 _ACC_INIT_CACHE.pop(next(iter(_ACC_INIT_CACHE)))
             _ACC_INIT_CACHE[init_key] = acc_init
-        return _Programs(run_scatter, run_pallas, acc_init, fold,
-                         have_pallas)
+        return _Programs(run_scatter, run_pallas, acc_init, fold)
 
     # -- flush: fetch + ordered merge ---------------------------------------
 
