@@ -120,7 +120,7 @@ def _scan(monkeypatch, datafile, qconf, native):
     return ds.scan(mod_query.query_load(dict(qconf))).points
 
 
-@pytest.mark.parametrize('seed', [1, 2, 3, 4, 5])
+@pytest.mark.parametrize('seed', [1, 2, 3, 4, 5, 6, 7])
 def test_fuzz_native_matches_python(tmp_path, monkeypatch, seed):
     rng = random.Random(seed)
     datafile = str(tmp_path / 'fuzz.log')
@@ -142,7 +142,7 @@ def test_fuzz_native_matches_python(tmp_path, monkeypatch, seed):
         assert py == nat, (seed, qconf)
 
 
-@pytest.mark.parametrize('seed', [11, 12, 13])
+@pytest.mark.parametrize('seed', [11, 12, 13, 14, 15])
 def test_fuzz_sparse_device_matches_host(tmp_path, monkeypatch, seed):
     """Random records through the device SPARSE program (dense budget
     forced tiny) vs the vectorized host engine — points AND counter
@@ -189,7 +189,7 @@ def test_fuzz_sparse_device_matches_host(tmp_path, monkeypatch, seed):
     assert hc == dc, seed
 
 
-@pytest.mark.parametrize('seed', [21, 22])
+@pytest.mark.parametrize('seed', [21, 22, 23])
 def test_fuzz_stacked_build_matches_host(tmp_path, monkeypatch, seed):
     """Random records through the stacked multi-metric device build vs
     the host build: byte-identical index artifacts."""
