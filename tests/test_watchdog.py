@@ -106,3 +106,29 @@ def test_untracked_and_collected_objects_ignored(isolated):
     out = io.StringIO()
     watchdog._run_checks(out)
     assert out.getvalue() == ''
+
+
+def test_watchdog_fires_at_interpreter_exit():
+    """The real atexit path: a process that exits with tracked lost
+    work must print the premature-exit error AND the per-stage
+    forensics dump to stderr."""
+    import subprocess
+    code = (
+        "import sys, os\n"
+        "sys.path.insert(0, %r)\n"
+        "from dragnet_tpu import watchdog\n"
+        "from dragnet_tpu.vpipe import Pipeline\n"
+        "class X(object):\n"
+        "    pass\n"
+        "c = watchdog.LeakCheck('scan(s) unflushed', lambda o: True)\n"
+        "x = X()\n"
+        "c.track(x)\n"
+        "p = Pipeline()\n"
+        "p.stage('json_parse').bump('ninputs', 123)\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, '-c', code],
+                         capture_output=True, timeout=120)
+    err = out.stderr
+    assert b'premature exit (1 scan(s) unflushed)' in err
+    assert b'premature-exit forensics' in err
+    assert b'json_parse         ninputs:          123' in err
