@@ -4,7 +4,7 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test check bench clean
+.PHONY: all native test check bench clean parity-matrix
 
 all: native
 
@@ -24,6 +24,14 @@ check:
 
 bench: native
 	$(PYTHON) bench.py
+
+# golden byte-parity under every engine (the strongest single seal:
+# host per-record, vectorized, forced device, auto router)
+parity-matrix: native
+	@for e in host vector jax auto; do \
+	    echo "== DN_ENGINE=$$e =="; \
+	    DN_ENGINE=$$e $(PYTHON) -m pytest tests/parity/ -q || exit 1; \
+	done
 
 clean:
 	rm -rf native/build
