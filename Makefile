@@ -4,7 +4,7 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test check bench clean parity-matrix
+.PHONY: all native test check bench bench-iq clean parity-matrix
 
 all: native
 
@@ -24,6 +24,11 @@ check:
 
 bench: native
 	$(PYTHON) bench.py
+
+# the serving-path legs only: 365-shard index-query fan-out
+# (sequential vs DN_IQ_THREADS pool, pruning, shard-handle cache)
+bench-iq: native
+	$(PYTHON) bench.py --iq-only
 
 # golden byte-parity under every engine (the strongest single seal:
 # host per-record, vectorized, forced device, auto router)

@@ -249,8 +249,11 @@ def index_query_bench(tmpdir):
     """Many-shard index tree: 365 daily shards (the shape the
     reference's per-file fan-in was built for,
     lib/datasource-file.js:629-689).  p50/p95 for full-tree and
-    30-day-window queries; concurrency-10 fan-in vs sequential."""
+    30-day-window queries; the DN_IQ_THREADS reader pool + shard-handle
+    cache (index_query_mt) vs the sequential open/query/close loop,
+    plus the shards-pruned count for the windowed query."""
     import shutil
+    from dragnet_tpu import index_query_mt as mod_iqmt
     datafile = os.path.join(tmpdir, 'year.log')
     idx = os.path.join(tmpdir, 'year.idx')
     n = 1000000
@@ -286,19 +289,51 @@ def index_query_bench(tmpdir):
         return (times[len(times) // 2],
                 times[min(len(times) - 1, int(len(times) * 0.95))])
 
-    ds.query(q(), 'day')            # warm
-    full_p50, full_p95 = measure(q(), 11)
-    win_p50, win_p95 = measure(
-        q('2014-06-01', '2014-07-01'), 11)
-    prior_conc = os.environ.get('DN_QUERY_CONCURRENCY')
-    os.environ['DN_QUERY_CONCURRENCY'] = '1'
-    try:
-        seq_p50, _ = measure(q(), 5)
-    finally:
-        if prior_conc is None:
-            os.environ.pop('DN_QUERY_CONCURRENCY', None)
+    def iq_env(threads):
+        prior = os.environ.get('DN_IQ_THREADS')
+        if threads is None:
+            os.environ.pop('DN_IQ_THREADS', None)
         else:
-            os.environ['DN_QUERY_CONCURRENCY'] = prior_conc
+            os.environ['DN_IQ_THREADS'] = threads
+        return prior
+
+    # pin BOTH knobs: an ambient DN_QUERY_CONCURRENCY=1 (the old
+    # harness's sequential override, a legacy alias for the pool size)
+    # must not silently turn the parallel legs sequential
+    prior_legacy = os.environ.pop('DN_QUERY_CONCURRENCY', None)
+    prior_auto = iq_env('auto')
+    try:
+        # cold: pool fan-out, nothing cached yet (first query after a
+        # rebuild in a long-running server)
+        mod_iqmt.shard_cache_clear()
+        t0 = time.monotonic()
+        ds.query(q(), 'day')
+        cold_ms = (time.monotonic() - t0) * 1000
+
+        # parallel (default DN_IQ_THREADS=auto), warm handle cache —
+        # the serving workload
+        full_p50, full_p95 = measure(q(), 11)
+        win_p50, win_p95 = measure(
+            q('2014-06-01', '2014-07-01'), 11)
+        # shards-pruned observability: hidden per-stage counter on the
+        # windowed query (365-shard tree, 30 in window)
+        win_result = ds.query(q('2014-06-01', '2014-07-01'), 'day')
+        pruned = queried = 0
+        for s in win_result.pipeline.stages:
+            pruned += s.counters.get('index shards pruned', 0)
+            queried += s.counters.get('index shards queried', 0)
+        cache_stats = mod_iqmt.shard_cache_stats()
+
+        # sequential baseline: DN_IQ_THREADS=0 (uncached
+        # open/query/close per shard — what every query paid before
+        # the reader pool)
+        iq_env('0')
+        seq_p50, seq_p95 = measure(q(), 5)
+    finally:
+        iq_env(prior_auto)
+        if prior_legacy is not None:
+            os.environ['DN_QUERY_CONCURRENCY'] = prior_legacy
+    mod_iqmt.shard_cache_clear()
     shutil.rmtree(idx, ignore_errors=True)
     os.unlink(datafile)
     return {
@@ -310,9 +345,18 @@ def index_query_bench(tmpdir):
                                           3),
         'index_query_p50_ms': round(full_p50, 2),
         'index_query_p95_ms': round(full_p95, 2),
+        'index_query_parallel_p50_ms': round(full_p50, 2),
+        'index_query_parallel_p95_ms': round(full_p95, 2),
+        'index_query_cold_ms': round(cold_ms, 2),
         'index_query_window_p50_ms': round(win_p50, 2),
         'index_query_window_p95_ms': round(win_p95, 2),
         'index_query_sequential_p50_ms': round(seq_p50, 2),
+        'index_query_sequential_p95_ms': round(seq_p95, 2),
+        'index_query_shards_pruned': pruned,
+        'index_query_window_shards_queried': queried,
+        'index_query_cache_hits': cache_stats['hits'],
+        'index_query_cache_misses': cache_stats['misses'],
+        'index_query_threads': mod_iqmt.iq_threads(),
     }
 
 
@@ -450,7 +494,40 @@ def device_alive(timeout_s=None):
     return alive
 
 
+def main_iq():
+    """Index-query legs only (`make bench-iq` / --iq-only): the serving
+    path's artifact without the scan/build/device legs."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_iq_')
+    try:
+        iq = index_query_bench(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    seq = iq['index_query_sequential_p50_ms']
+    par = iq['index_query_parallel_p50_ms']
+    sys.stderr.write(
+        'bench-iq: %d shards; parallel p50 %.1fms (seq %.1fms, %.1fx); '
+        'window p50 %.1fms (%d pruned); cache %d hits / %d misses\n'
+        % (iq['index_query_shards'], par, seq,
+           seq / par if par else 0.0,
+           iq['index_query_window_p50_ms'],
+           iq['index_query_shards_pruned'],
+           iq['index_query_cache_hits'],
+           iq['index_query_cache_misses']))
+    print(json.dumps({
+        'metric': 'index_query_parallel_p50_ms',
+        'value': par,
+        'unit': 'ms',
+        'vs_baseline': round(seq / par, 3) if par else None,
+        'extra': iq,
+    }))
+
+
 def main():
+    if '--iq-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'iq':
+        return main_iq()
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)

@@ -341,6 +341,44 @@ class Aggregator(object):
         for item in rec(root, 0, []):
             yield item
 
+    def key_items(self):
+        """(keys_tuple, weight) pairs in first-occurrence order — the
+        transferable wire format of this aggregate (the index-shard
+        fan-out).  Replaying the pairs into another Aggregator for the
+        same query via write_key() merges byte-identically to
+        re-writing points():
+
+        * keys round-trip exactly (bucketize(bucket_min(i)) == i for
+          both bucketizers; non-bucketized keys are already to_string'd)
+        * emitting insertion order instead of points()'s _walk order
+          cannot change the receiver's output, because the receiver
+          re-walks: integer-like keys re-sort numerically regardless of
+          insertion order, and the relative first-occurrence order of
+          the remaining (string-like) keys is the same under both
+          emission orders.
+        """
+        assert self._cols is None, 'key_items after columnar conversion'
+        if not self.decomps:
+            return [((), self.total)]
+        return list(self.flat.items())
+
+    def merge_key_items(self, items):
+        """Bulk write_key: replay a key_items() transfer into this
+        aggregate (the index-shard fan-in's hot loop — one dict upsert
+        per pair, no per-pair method call)."""
+        if self._cols is not None:
+            raise RuntimeError(
+                'Aggregator.write after columnar conversion')
+        self.nrecords += len(items)
+        if not self.decomps:
+            for _, value in items:
+                self.total += value
+            return
+        flat = self.flat
+        get = flat.get
+        for keys, value in items:
+            flat[keys] = get(keys, 0) + value
+
     def points(self):
         """Aggregated points: fields carry bucket-min values for bucketized
         fields (re-ingestable), strings otherwise."""

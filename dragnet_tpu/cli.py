@@ -84,6 +84,10 @@ DN_OPTIONS = [
     (['gnuplot'], 'bool', None),
     (['interval', 'i'], 'string', 'day'),
     (['index-config'], 'string', None),
+    # index-query worker pool override (not in USAGE_TEXT: the usage
+    # output is byte-pinned to the reference goldens; documented in
+    # docs/performance.md).  Equivalent to DN_IQ_THREADS for one run.
+    (['iq-threads'], 'string', None),
     (['index-path'], 'string', None),
     (['path'], 'string', None),
     (['points'], 'bool', None),
@@ -514,17 +518,41 @@ def cmd_scan(ctx, argv):
 def cmd_query(ctx, argv):
     opts = dn_parse_args(argv, ['before', 'after', 'filter', 'breakdowns',
                                 'raw', 'points', 'counters', 'interval',
-                                'gnuplot', 'assetroot', 'dry-run'])
+                                'gnuplot', 'assetroot', 'dry-run',
+                                'iq-threads'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     ds = datasource_for_name(ctx['config'], dsname)
     if isinstance(ds, DNError):
         fatal(ds)
     query = dn_query_config(opts)
+
+    # --iq-threads plumbs the shard fan-out width for this run only
+    # (the datasource layer reads DN_IQ_THREADS; restore it because
+    # the parity harness drives this entry point in-process).  Unlike
+    # the env var, a bad explicit flag value is a usage error, not a
+    # silent fallback to sequential.
+    if opts.iq_threads is not None and opts.iq_threads != 'auto':
+        try:
+            if int(opts.iq_threads) < 0:
+                raise ValueError(opts.iq_threads)
+        except ValueError:
+            raise UsageError('bad value for "iq-threads": "%s"'
+                             % opts.iq_threads)
+    import os
+    prior_iq = os.environ.get('DN_IQ_THREADS')
+    if opts.iq_threads is not None:
+        os.environ['DN_IQ_THREADS'] = opts.iq_threads
     try:
         result = ds.query(query, opts.interval, dry_run=opts.dry_run)
     except DNError as e:
         fatal(e)
+    finally:
+        if opts.iq_threads is not None:
+            if prior_iq is None:
+                os.environ.pop('DN_IQ_THREADS', None)
+            else:
+                os.environ['DN_IQ_THREADS'] = prior_iq
     dn_output(query, opts, result, dsname)
 
 

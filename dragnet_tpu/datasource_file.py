@@ -26,7 +26,6 @@ from .aggr import Aggregator
 from .scan import StreamScan
 from .vpipe import Pipeline
 from .index_sink import make_index_sink
-from .index_query import open_index
 
 LOG = mod_log.get('datasource-file')
 
@@ -790,12 +789,17 @@ class DatasourceFile(object):
         """Write aggregated points into interval-chunked index files;
         sinks are created lazily per time bucket and each file is written
         atomically.  (reference: lib/datasource-file.js:444-547)"""
+        # rewritten shards must not serve from stale cached handles
+        # (in-process build-then-query, the serving refresh cycle)
+        from .index_query_mt import shard_cache_invalidate
+
         if interval == 'all':
-            sink = make_index_sink(metrics,
-                                   os.path.join(self.ds_indexpath, 'all'))
+            allpath = os.path.join(self.ds_indexpath, 'all')
+            sink = make_index_sink(metrics, allpath)
             for fields, value in tagged_points:
                 sink.write(fields, value)
             sink.flush()
+            shard_cache_invalidate(allpath)
             return
 
         if interval == 'hour':
@@ -809,6 +813,7 @@ class DatasourceFile(object):
 
         root = os.path.join(self.ds_indexpath, 'by_' + interval)
         sinks = {}
+        sinkpaths = {}
         for fields, value in tagged_points:
             dnts = fields['__dn_ts']
             assert jsv.is_number(dnts)
@@ -820,9 +825,11 @@ class DatasourceFile(object):
                 indexpath = os.path.join(root, label + '.sqlite')
                 sinks[bucketname] = make_index_sink(
                     metrics, indexpath, config={'dn_start': bucketstart})
+                sinkpaths[bucketname] = indexpath
             sinks[bucketname].write(fields, value)
-        for sink in sinks.values():
+        for bucketname, sink in sinks.items():
             sink.flush()
+            shard_cache_invalidate(sinkpaths[bucketname])
 
     def index_read(self, metrics, interval, instream):
         """Read tagged json-skinner points (from stdin) and write index
@@ -879,45 +886,47 @@ class DatasourceFile(object):
         aggr = Aggregator(query,
                           stage=pipeline.stage('Index Result Aggregator'))
 
-        def query_one(path):
-            try:
-                qi = open_index(path)
-            except DNError as e:
-                raise DNError('index "%s"' % path, cause=e)
-            try:
-                sub = Aggregator(query)
-                qi.run(query, aggr=sub)
-            except DNError as e:
-                raise DNError('index "%s" query' % path, cause=e)
-            finally:
-                qi.close()
-            return sub.points()
-
-        # per-index-file fan-out at concurrency 10, merged in find
-        # order (the reference's vasync barrier did the same,
-        # lib/datasource-file.js:629-689); sequential for small trees
+        # Shard fan-out (index_query_mt): time-range pruning by shard
+        # filename, then a DN_IQ_THREADS worker pool over the shard
+        # handle cache, merged in find order — byte-identical to the
+        # sequential loop (the reference's vasync barrier merged the
+        # same way, lib/datasource-file.js:629-689).
+        from . import index_query_mt as mod_iqmt
         paths = [p for p, st in files]
+        paths, npruned = mod_iqmt.prune_shards(
+            paths, timeformat, query.qc_after, query.qc_before)
+        # time-bounded finds never enumerate out-of-window shards, so
+        # count the tree's skipped files for the pruned counter (the
+        # found list can only re-prune what enumeration missed)
+        npruned = max(npruned, mod_iqmt.count_pruned_shards(
+            root, timeformat, query.qc_after, query.qc_before))
+        if npruned:
+            index_list.bump_hidden('index shards pruned', npruned)
+        index_list.bump_hidden('index shards queried', len(paths))
+
+        nworkers = mod_iqmt.iq_threads()
         LOG.debug('query start', indexroot=root, nindexes=len(paths),
+                  npruned=npruned, nworkers=nworkers,
                   interval=interval)
-        conc = min(10, len(paths))
-        try:
-            # bench/testing override: DN_QUERY_CONCURRENCY=1 measures
-            # the sequential fan-in against the default overlap
-            conc = max(1, min(int(os.environ.get(
-                'DN_QUERY_CONCURRENCY', conc)), len(paths)))
-        except ValueError:
-            pass
-        if conc > 1:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=conc) as pool:
-                results = list(pool.map(query_one, paths))
-        else:
-            results = [query_one(p) for p in paths]
-        for pts in results:
-            for fields, value in pts:
-                index_list.bump('ninputs')
-                index_list.bump('noutputs')
-                aggr.write(fields, value)
+
+        aggr_stage = aggr.stage
+
+        def merge(items):
+            # per-shard aggregates arrive as key items (the Aggregator
+            # wire format) in emission order: write_key replays them
+            # byte-identically to re-writing the shard's points.
+            # Counter parity with the per-point write() loop: one Index
+            # List input/output and one aggregator-stage input per
+            # point, bumped in bulk.
+            npts = len(items)
+            if npts == 0:
+                return
+            index_list.bump('ninputs', npts)
+            index_list.bump('noutputs', npts)
+            aggr_stage.bump('ninputs', npts)
+            aggr.merge_key_items(items)
+
+        mod_iqmt.run_shard_queries(paths, query, nworkers, merge)
 
         return ScanResult(pipeline, points=aggr.points(), query=query)
 

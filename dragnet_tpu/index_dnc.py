@@ -623,7 +623,9 @@ class DncIndexQuerier(IndexQuerierBase):
 
     # -- GROUP BY / SUM ----------------------------------------------------
 
-    def _execute(self, table_ref, filt, groupby):
+    def _grouped(self, table_ref, filt, groupby):
+        """Masked GROUP BY/SUM over the mapped columns: returns
+        (decoders, key_columns_as_lists, sums_list, isint_list)."""
         t = self._table(table_ref)
         n = t['nrows']
         mask = self._eval_mask(filt, t, n)
@@ -657,6 +659,17 @@ class DncIndexQuerier(IndexQuerierBase):
         if res is None:
             res = _groupby_numpy(keycols, values, isint, mask)
         out_keys, sums, flags = res
+        # bulk-convert to Python scalars once (tolist) instead of one
+        # numpy-scalar __int__/__float__ per emitted cell
+        return (decoders,
+                [np.asarray(k, dtype=np.int64).tolist()
+                 for k in out_keys],
+                np.asarray(sums, dtype=np.float64).tolist(),
+                np.asarray(flags).tolist())
+
+    def _execute(self, table_ref, filt, groupby):
+        decoders, out_keys, sums, flags = self._grouped(
+            table_ref, filt, groupby)
         ngroups = len(sums)
 
         if not groupby and ngroups == 0:
@@ -667,15 +680,85 @@ class DncIndexQuerier(IndexQuerierBase):
         for g in range(ngroups):
             rd = {}
             for k, name in enumerate(groupby):
-                kv = int(out_keys[k][g])
+                kv = out_keys[k][g]
                 dec = decoders[k]
                 if dec is None:
                     rd[name] = kv
                 else:
                     rd[name] = None if kv < 0 else dec[kv]
-            s = float(sums[g])
+            s = sums[g]
             rd['value'] = int(s) if flags[g] else s
             yield rd
+
+    def _execute_keys(self, table_ref, filt, groupby, query, aggr):
+        """The serving-path fast lane: grouped rows become write_key()
+        tuples directly — no row dicts, no pluck, no re-coercion of
+        values Aggregator.write would just round-trip.  Engaged only
+        when the mapping is provably 1:1 with the row path: every
+        breakdown selects its own column (field == name, so the
+        groupby projection covers every breakdown in order) and the
+        target aggregator has no stage (its write() would bump
+        per-record counters write_key() does not)."""
+        if aggr.stage is not None:
+            return False
+        bds = query.qc_breakdowns
+        if len(groupby) != len(bds):
+            return False
+        for b in bds:
+            if b.get('field', b['name']) != b['name']:
+                return False
+
+        decoders, out_keys, sums, flags = self._grouped(
+            table_ref, filt, groupby)
+        ngroups = len(sums)
+
+        if not groupby:
+            # SELECT SUM(value) with no GROUP BY: one row, NULL -> 0
+            if ngroups == 0:
+                aggr.write_key((), 0)
+            else:
+                s = sums[0]
+                aggr.write_key((), int(s) if flags[0] else s)
+            return True
+
+        jsv_to_string = jsv.to_string
+        jsv_to_number = jsv.to_number
+        jsv_is_number = jsv.is_number
+        bucketizers = [query.qc_bucketizers.get(b['name']) for b in bds]
+        nkeys = len(groupby)
+        for g in range(ngroups):
+            keys = []
+            dropped = False
+            for k in range(nkeys):
+                kv = out_keys[k][g]
+                dec = decoders[k]
+                v = kv if dec is None else \
+                    (None if kv < 0 else dec[kv])
+                bk = bucketizers[k]
+                if bk is None:
+                    # to_string returns str operands verbatim; skip
+                    # its type dispatch for the common decoded case
+                    keys.append(v if type(v) is str
+                                else jsv_to_string(v))
+                    continue
+                # mirror Aggregator.write's JS numeric coercion for
+                # bucketized fields exactly (numeric strings coerce,
+                # anything else drops the row)
+                if isinstance(v, str):
+                    fv = jsv_to_number(v)
+                    v = None if fv != fv else \
+                        (int(fv) if fv == int(fv) else fv)
+                elif not jsv_is_number(v):
+                    v = None
+                if v is None:
+                    dropped = True
+                    break
+                keys.append(bk.bucketize(v))
+            if dropped:
+                continue
+            s = sums[g]
+            aggr.write_key(tuple(keys), int(s) if flags[g] else s)
+        return True
 
     def _dict_strings(self, c, entries):
         cached = c.get('_strings')

@@ -171,23 +171,39 @@ class IndexQuerierBase(object):
         filt = self._compose_filter(query, table)
         groupby = self._groupby_columns(query)
 
-        for rd in self._execute(table, filt, groupby):
-            fields, value = self._deserialize_row(query, rd)
-            aggr.write(fields, value)
+        if not self._execute_keys(table, filt, groupby, query, aggr):
+            # column escapes hoisted out of the per-row loop (the
+            # serving path deserializes tens of rows per shard across
+            # hundreds of shards per query)
+            cols = [(f['name'], sqlite3_escape(f['field']))
+                    for f in query.qc_breakdowns]
+            for rd in self._execute(table, filt, groupby):
+                fields, value = self._deserialize_row(cols, rd)
+                aggr.write(fields, value)
         if own_aggr:
             return aggr.points()
         return None
 
-    def _deserialize_row(self, query, rd):
-        """(reference: lib/index-query.js:382-405; NULL SUM -> 0)"""
+    def _execute_keys(self, table, filt, groupby, query, aggr):
+        """Storage-engine hook: aggregate grouped rows directly as
+        write_key() tuples, skipping row-dict materialization and the
+        per-row pluck/coerce work of Aggregator.write — must produce
+        byte-identical aggregates (differential-tested).  Returns False
+        to take the row path instead (the base always does; the DNC
+        engine overrides)."""
+        return False
+
+    def _deserialize_row(self, cols, rd):
+        """(reference: lib/index-query.js:382-405; NULL SUM -> 0).
+        `cols` is the [(name, escaped_column)] projection of the
+        query's breakdowns."""
         value = rd.get('value')
         if value is None:
             value = 0
         fields = {}
-        for field in query.qc_breakdowns:
-            col = sqlite3_escape(field['field'])
+        for name, col in cols:
             if col in rd:
-                fields[field['name']] = rd[col]
+                fields[name] = rd[col]
             # absent column: leave unset (JS undefined semantics)
         return (fields, value)
 
@@ -197,8 +213,14 @@ class IndexQuerier(IndexQuerierBase):
 
     def __init__(self, filename):
         self.qi_dbfilename = filename
+        # check_same_thread=False: the shard-handle cache
+        # (index_query_mt) leases a querier to one worker thread at a
+        # time, so a connection opened on one thread is later used —
+        # never concurrently — on another; read-only + serialized
+        # access makes that safe.
         self.qi_db = sqlite3.connect(
-            'file:%s?mode=ro' % filename.replace('?', '%3f'), uri=True)
+            'file:%s?mode=ro' % filename.replace('?', '%3f'), uri=True,
+            check_same_thread=False)
         self.qi_config = None
         self.qi_metrics = None
         self._load_config()

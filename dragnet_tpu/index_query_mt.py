@@ -1,0 +1,571 @@
+"""Parallel index-shard query fan-out: reader pool, time-range pruning,
+and a shard-handle cache.
+
+The serving path (`dn query`) answers from pre-built hour/day index
+shards.  The reference fanned per-index-file queries out with a vasync
+barrier at concurrency 10 (lib/datasource-file.js:629-689) and merged in
+find order; our round-5 bench showed that a thread-pool map alone buys
+nothing (index_query_p50_ms 238.7 vs sequential 218.6 over 365 shards)
+because per-query shard *open* cost — footer parse, config/metrics
+parse, dictionary decode — dominates and repeats on every query.
+
+This module owns the three serving-path optimizations:
+
+* ShardQueryExecutor: a bounded worker pool that queries shards
+  concurrently and merges per-shard point lists IN FIND ORDER on the
+  caller's thread (the same replay-in-order trick scan_mt.py uses), so
+  output — including the aggregator's insertion-ordered emission, which
+  the goldens pin — is byte-identical to the sequential path for any
+  worker count.  DN_IQ_THREADS sets the pool size (auto = up to 6,
+  bounded by CPU count; 0 = the sequential open/query/close loop).
+
+* Time-range pruning: each hour/day shard's coverage window is derived
+  from its strftime filename layout (the same %Y/%m/%d/%H vocabulary
+  find.py's PathEnumerator expands), and shards wholly outside the
+  query's [after, before) bounds are skipped without being opened.
+  Pruned/queried counts are reported as hidden per-stage counters
+  ("index shards pruned" / "index shards queried" on the Index List
+  stage — hidden because the --counters byte format is pinned to the
+  reference goldens; DN_COUNTERS_ALL=1 makes them visible).
+
+* A process-wide LRU cache of open shard handles (DNC mmap / sqlite3
+  connections plus their parsed config, metrics, and decoded
+  dictionaries) keyed by (path, mtime_ns, size, inode), so repeated
+  queries against the same index set — the serving workload — skip
+  open/parse cost entirely.  Handles are leased exclusively to one
+  worker at a time; index writers invalidate rewritten paths.  A
+  watchdog.LeakCheck makes undrained executors and leaked (never
+  checked-in) handles fail loudly at exit.
+"""
+
+import os
+import queue
+import threading
+import time
+from collections import OrderedDict
+from datetime import datetime, timedelta, timezone
+
+from .errors import DNError
+from .aggr import Aggregator
+from .watchdog import LeakCheck
+from . import find as mod_find
+from .index_query import open_index
+
+# an executor that is never drained means submitted shards may never
+# have merged into the result
+_EXECUTOR_LEAKS = LeakCheck(
+    'index-query executor(s) never drained; results may be incomplete',
+    lambda ex: not ex.closed)
+
+# a handle checked out of the cache but never checked back in (or
+# closed) holds an open file/connection and blocks reuse
+_HANDLE_LEAKS = LeakCheck(
+    'index shard handle(s) leased but never released',
+    lambda h: h.leased)
+
+
+def iq_threads():
+    """Worker-pool size for the index-query fan-out.  DN_IQ_THREADS:
+    auto (default) = min(6, cpus - 1) — one core stays with the
+    caller, which merges results and walks the index tree concurrently
+    with the pool (shard queries are partially GIL-bound, so a pool as
+    wide as the machine convoys with the merger instead of helping);
+    at least 1, 0 = sequential.  DN_QUERY_CONCURRENCY is honored as a
+    legacy alias (1 = sequential) when DN_IQ_THREADS is unset."""
+    v = os.environ.get('DN_IQ_THREADS')
+    if v is None:
+        legacy = os.environ.get('DN_QUERY_CONCURRENCY')
+        if legacy is not None:
+            try:
+                n = int(legacy)
+            except ValueError:
+                n = None     # unparseable: fail open to auto, as the
+            if n is not None:  # pre-pool code ignored bad values
+                return 0 if n <= 1 else n
+        v = 'auto'
+    if v != 'auto':
+        try:
+            return max(0, int(v))
+        except ValueError:
+            return 0
+    return max(1, min(6, (os.cpu_count() or 2) - 1))
+
+
+# -- shard filename time ranges ------------------------------------------
+
+def shard_time_range(path, timeformat):
+    """The [start_ms, end_ms) coverage window a shard's filename
+    declares, derived from the interval tree's strftime layout
+    ('%Y-%m-%d.sqlite' for day trees, '%Y-%m-%d-%H.sqlite' for hour
+    trees).  Returns None when the name doesn't match the layout —
+    callers must treat such shards as covering all time (query, don't
+    prune)."""
+    entries = _layout_entries(timeformat)
+    if entries is None:
+        return None
+    return _range_from_entries(path, entries)
+
+
+def _layout_entries(timeformat):
+    """Parse the layout pattern once per query, not once per shard."""
+    entries = mod_find.parse_strftime_pattern(
+        os.path.basename(timeformat))
+    if isinstance(entries, DNError):
+        return None
+    return entries
+
+
+def _range_from_entries(path, entries):
+    name = os.path.basename(path)
+    vals = {}
+    i = 0
+    for entry in entries:
+        if entry['kind'] == 'str':
+            if not name.startswith(entry['value'], i):
+                return None
+            i += len(entry['value'])
+            continue
+        width = 4 if entry['kind'] == 'Y' else 2
+        digits = name[i:i + width]
+        if len(digits) != width or not digits.isdigit():
+            return None
+        vals[entry['kind']] = int(digits)
+        i += width
+    if i != len(name) or 'Y' not in vals:
+        return None
+    try:
+        start = datetime(vals['Y'], vals.get('m', 1), vals.get('d', 1),
+                         vals.get('H', 0), tzinfo=timezone.utc)
+    except ValueError:
+        return None
+    if 'H' in vals:
+        end = start + timedelta(hours=1)
+    elif 'd' in vals:
+        end = start + timedelta(days=1)
+    elif 'm' in vals:
+        end = start.replace(year=start.year + 1, month=1) \
+            if start.month == 12 else start.replace(month=start.month + 1)
+    else:
+        end = start.replace(year=start.year + 1)
+    return (int(start.timestamp() * 1000), int(end.timestamp() * 1000))
+
+
+def prune_shards(paths, timeformat, after_ms, before_ms):
+    """Drop shards whose filename window is wholly outside the query's
+    [after_ms, before_ms) bounds.  Returns (kept_paths, npruned).
+    Shards with unparseable names are kept (they may cover any time) —
+    same fail-open rule for a None timeformat or unbounded query."""
+    if timeformat is None or before_ms is None or after_ms is None:
+        return (list(paths), 0)
+    entries = _layout_entries(timeformat)
+    if entries is None:
+        return (list(paths), 0)
+    kept = []
+    npruned = 0
+    for path in paths:
+        window = _range_from_entries(path, entries)
+        if window is not None and \
+                not (window[0] < before_ms and window[1] > after_ms):
+            npruned += 1
+            continue
+        kept.append(path)
+    return (kept, npruned)
+
+
+def count_pruned_shards(root, timeformat, after_ms, before_ms):
+    """How many shard files in the interval tree fall wholly outside the
+    query bounds.  Time-bounded queries never even enumerate these (the
+    strftime path enumerator expands only in-window names), so this one
+    cheap listdir is what makes the skipped work observable in
+    counters."""
+    if timeformat is None or before_ms is None or after_ms is None:
+        return 0
+    entries = _layout_entries(timeformat)
+    if entries is None:
+        return 0
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    npruned = 0
+    for name in names:
+        window = _range_from_entries(name, entries)
+        if window is not None and \
+                not (window[0] < before_ms and window[1] > after_ms):
+            npruned += 1
+    return npruned
+
+
+# -- shard handle cache ---------------------------------------------------
+
+class ShardHandle(object):
+    """An open shard querier plus the stat identity it was opened
+    against.  `leased` is True while exactly one worker owns it;
+    `checked_at` is when the stat identity was last verified; `gen` is
+    the path's invalidation generation at lease time (a handle leased
+    across a shard_cache_invalidate call must not re-enter the
+    cache)."""
+
+    __slots__ = ('path', 'statkey', 'querier', 'leased', 'checked_at',
+                 'last_used', 'gen', '__weakref__')
+
+    def __init__(self, path, statkey, querier, now, gen):
+        self.path = path
+        self.statkey = statkey
+        self.querier = querier
+        self.leased = True
+        self.checked_at = now
+        self.last_used = now
+        self.gen = gen
+        _HANDLE_LEAKS.track(self)
+
+
+_CACHE_LOCK = threading.Lock()
+_CACHE = OrderedDict()          # path -> ShardHandle (not leased)
+_CACHE_STATS = {'hits': 0, 'misses': 0}
+# path -> invalidation generation: bumped by shard_cache_invalidate so
+# handles leased across the invalidation (and thus missed by the cache
+# pop) are closed at checkin instead of re-cached.  _EPOCH is the
+# cache-wide analog for shard_cache_clear: a handle leased across a
+# clear must not re-enter the emptied cache either.
+_INVAL_GEN = {}
+_EPOCH = [0]
+
+
+_CAP_MEMO = [None, 0]      # (env value, capacity) — getrlimit once
+
+
+def _cache_capacity():
+    """DN_IQ_CACHE caps cached handles (0 disables); auto = 512 bounded
+    to a quarter of the fd soft limit (each handle holds an open file
+    or sqlite connection)."""
+    v = os.environ.get('DN_IQ_CACHE', 'auto')
+    if v == _CAP_MEMO[0]:
+        return _CAP_MEMO[1]
+    if v != 'auto':
+        try:
+            cap = max(0, int(v))
+        except ValueError:
+            cap = 0
+    else:
+        cap = 512
+        try:
+            import resource
+            soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+            if soft > 0:
+                cap = min(cap, max(16, soft // 4))
+        except Exception:
+            pass
+    _CAP_MEMO[0] = v
+    _CAP_MEMO[1] = cap
+    return cap
+
+
+_TTL_MEMO = [None, 0.0]
+
+
+def _stat_ttl():
+    """How long (seconds) a cached handle's verified stat identity
+    stays trusted without re-statting.  In-process writers invalidate
+    explicitly, so the stat only guards against *external* rewrites;
+    amortizing it (DN_IQ_STAT_TTL_MS, default 1000) keeps the serving
+    hot path off the filesystem — the open-file-cache validity-timer
+    pattern.  0 re-stats on every checkout."""
+    v = os.environ.get('DN_IQ_STAT_TTL_MS', '1000')
+    if v == _TTL_MEMO[0]:
+        return _TTL_MEMO[1]
+    try:
+        ttl = max(0, int(v)) / 1000.0
+    except ValueError:
+        ttl = 1.0
+    _TTL_MEMO[0] = v
+    _TTL_MEMO[1] = ttl
+    return ttl
+
+
+def _statkey(path):
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None       # open_index reports the real error
+    return (st.st_mtime_ns, st.st_size, st.st_ino)
+
+
+def checkout_shard(path):
+    """Lease a querier for `path`: a cached handle when its stat
+    identity still matches (verified at most once per stat TTL), a
+    fresh open otherwise.  Raises the same DNError('index "<path>"')
+    the sequential path raises on a bad open."""
+    if _cache_capacity() > 0:
+        with _CACHE_LOCK:
+            handle = _CACHE.pop(path, None)
+        if handle is not None:
+            now = time.monotonic()
+            if now - handle.checked_at < _stat_ttl():
+                with _CACHE_LOCK:
+                    _CACHE_STATS['hits'] += 1
+                handle.last_used = now
+                handle.leased = True
+                return handle
+            statkey = _statkey(path)
+            if statkey is not None and handle.statkey == statkey:
+                with _CACHE_LOCK:
+                    _CACHE_STATS['hits'] += 1
+                handle.checked_at = now
+                handle.last_used = now
+                handle.leased = True
+                return handle
+            handle.querier.close()    # rewritten underneath the cache
+    with _CACHE_LOCK:
+        _CACHE_STATS['misses'] += 1
+        gen = (_EPOCH[0], _INVAL_GEN.get(path, 0))
+    statkey = _statkey(path)
+    try:
+        querier = open_index(path)
+    except DNError as e:
+        raise DNError('index "%s"' % path, cause=e)
+    return ShardHandle(path, statkey, querier, time.monotonic(), gen)
+
+
+def checkin_shard(handle, ok=True):
+    """Return a leased handle.  Healthy handles of stat-identified files
+    go back into the LRU (evicting the oldest beyond capacity); failed
+    or unidentifiable ones are closed."""
+    handle.leased = False
+    cap = _cache_capacity()
+    if not ok or cap <= 0 or handle.statkey is None:
+        handle.querier.close()
+        return
+    closing = []
+    now = time.monotonic()
+    # an LRU entry still hot (used within the admission window) is
+    # about to be requested again: under a cyclic full-tree sweep
+    # wider than the cache, evicting it for the incoming handle gives
+    # a 0% hit rate (every shard evicted moments before its reuse).
+    # Rejecting the admission instead keeps a resident prefix and a
+    # capacity/nshards hit rate; entries idle past the window age out
+    # normally, so workload shifts still repopulate the cache.
+    stale_before = now - max(1.0, _stat_ttl())
+    with _CACHE_LOCK:
+        if (_EPOCH[0], _INVAL_GEN.get(handle.path, 0)) != handle.gen:
+            # the shard was invalidated (rewritten) or the cache
+            # cleared while this handle was leased — it must not
+            # serve again
+            closing.append(handle)
+        else:
+            old = _CACHE.pop(handle.path, None)
+            if old is not None:
+                closing.append(old)
+            if old is not None or len(_CACHE) < cap:
+                _CACHE[handle.path] = handle
+                while len(_CACHE) > cap:
+                    closing.append(_CACHE.popitem(last=False)[1])
+            else:
+                lru = next(iter(_CACHE.values()))
+                if lru.last_used < stale_before:
+                    closing.append(_CACHE.popitem(last=False)[1])
+                    _CACHE[handle.path] = handle
+                else:
+                    closing.append(handle)    # admission rejected
+    for stale in closing:
+        stale.querier.close()
+
+
+def shard_cache_invalidate(path):
+    """Drop (and close) any cached handle for `path` — index writers
+    call this after rewriting a shard, so in-process serving sees the
+    new bytes even if the stat identity were to collide.  Handles
+    currently leased to a worker are invalidated at checkin via the
+    per-path generation."""
+    with _CACHE_LOCK:
+        _INVAL_GEN[path] = _INVAL_GEN.get(path, 0) + 1
+        handle = _CACHE.pop(path, None)
+    if handle is not None:
+        handle.querier.close()
+
+
+def shard_cache_clear():
+    """Close every cached handle (tests, and before deleting index
+    trees)."""
+    with _CACHE_LOCK:
+        handles = list(_CACHE.values())
+        _CACHE.clear()
+        _INVAL_GEN.clear()
+        _EPOCH[0] += 1     # leased handles must not re-enter
+        _CACHE_STATS['hits'] = 0
+        _CACHE_STATS['misses'] = 0
+    for handle in handles:
+        handle.querier.close()
+
+
+def shard_cache_stats():
+    with _CACHE_LOCK:
+        return dict(_CACHE_STATS, size=len(_CACHE))
+
+
+# -- query execution ------------------------------------------------------
+
+def query_shard_once(path, query):
+    """The sequential building block: open (uncached), query into a
+    fresh sub-aggregator, close.  Error wrapping matches the reference
+    fan-in (lib/datasource-file.js:629-689).  Returns the shard's
+    aggregate as key items (Aggregator.key_items order) — replaying
+    them with write_key() merges byte-identically to re-writing the
+    shard's points."""
+    try:
+        querier = open_index(path)
+    except DNError as e:
+        raise DNError('index "%s"' % path, cause=e)
+    try:
+        sub = Aggregator(query)
+        querier.run(query, aggr=sub)
+        return list(sub.key_items())
+    except DNError as e:
+        raise DNError('index "%s" query' % path, cause=e)
+    finally:
+        querier.close()
+
+
+def _query_shard_cached(path, query):
+    handle = checkout_shard(path)
+    ok = False
+    try:
+        sub = Aggregator(query)
+        handle.querier.run(query, aggr=sub)
+        items = list(sub.key_items())
+        ok = True
+        return items
+    except DNError as e:
+        raise DNError('index "%s" query' % path, cause=e)
+    finally:
+        checkin_shard(handle, ok=ok)
+
+
+class ShardQueryExecutor(object):
+    """Fan a query out across index shards on a worker pool and merge
+    per-shard results in submission (find) order.
+
+    Shards are dispatched in CHUNKS (a warm cached shard query runs
+    well under a millisecond, so per-shard queue handoffs would cost
+    more in lock wakeups and GIL switches than the work itself).
+    Workers pull (seq, [paths]) off a bounded queue, query each shard
+    through the handle cache into a private sub-aggregator, and post
+    (seq, [key_items...]) results; the caller's thread replays results
+    into the real aggregator strictly by seq — so output and counter
+    totals are byte-identical to the sequential loop.  The first shard
+    error (by find order, deterministically) aborts the run and
+    re-raises after the pool drains."""
+
+    QUEUE_DEPTH = 4
+    MAX_CHUNK = 32
+
+    def __init__(self, query, nworkers):
+        assert nworkers >= 1, nworkers
+        self.closed = False
+        _EXECUTOR_LEAKS.track(self)
+        self.query = query
+        self.nworkers = nworkers
+        self.workq = queue.Queue(maxsize=nworkers + self.QUEUE_DEPTH)
+        self.resultq = queue.Queue()
+        self._stopping = False
+        self.threads = []
+        for _ in range(nworkers):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self.threads.append(t)
+
+    def _worker(self):
+        while True:
+            item = self.workq.get()
+            if item is None:
+                return
+            seq, chunk = item
+            results = []
+            error = None
+            if not self._stopping:
+                for path in chunk:
+                    try:
+                        results.append(
+                            _query_shard_cached(path, self.query))
+                    except BaseException as e:
+                        error = e     # shards before it still merge
+                        break
+            self.resultq.put((seq, results, error))
+
+    def run(self, paths, on_items):
+        """Query every shard in `paths`, calling on_items(key_items)
+        once per shard in find order; returns after all shards merged.
+        Must be called exactly once."""
+        # ~4 chunks per worker balances handoff amortization against
+        # tail imbalance
+        chunk = max(1, min(self.MAX_CHUNK,
+                           len(paths) // (self.nworkers * 4) or 1))
+        pending = {}
+        state = {'want': 0, 'error': None}
+
+        def drain(block):
+            try:
+                item = self.resultq.get(block=block)
+            except queue.Empty:
+                return False
+            seq, results, error = item
+            pending[seq] = (results, error)
+            while state['want'] in pending:
+                results, error = pending.pop(state['want'])
+                state['want'] += 1
+                if state['error'] is not None:
+                    continue
+                for items in results:
+                    on_items(items)
+                if error is not None:
+                    state['error'] = error
+                    self._stopping = True
+            return True
+
+        try:
+            nsubmitted = 0
+            for start in range(0, len(paths), chunk):
+                if state['error'] is not None:
+                    break
+                self.workq.put((nsubmitted,
+                                paths[start:start + chunk]))
+                nsubmitted += 1
+                while drain(False):
+                    pass
+            while state['want'] < nsubmitted:
+                drain(True)
+        finally:
+            self.close()
+        if state['error'] is not None:
+            raise state['error']
+
+    def close(self):
+        if self.closed:
+            return
+        self._stopping = True
+        for _ in self.threads:
+            self.workq.put(None)
+        for t in self.threads:
+            t.join()
+        self.threads = []
+        self.closed = True
+
+
+def run_shard_queries(paths, query, nworkers, on_items):
+    """Entry point for the datasource query path: fan out across
+    `paths` on `nworkers` threads (0 = the sequential uncached loop,
+    byte-identical output either way), merging per-shard key items in
+    find order through on_items.  A single shard skips the pool but
+    still goes through the handle cache — repeated narrow queries
+    (an 'all' index, a window pruned to one shard) are exactly the
+    serving shape the cache amortizes."""
+    if nworkers <= 0:
+        for path in paths:
+            on_items(query_shard_once(path, query))
+    elif len(paths) == 0:
+        return                    # empty window: nothing to query
+    elif len(paths) == 1:
+        on_items(_query_shard_cached(paths[0], query))
+    else:
+        ex = ShardQueryExecutor(query, min(nworkers, len(paths)))
+        ex.run(paths, on_items)

@@ -20,6 +20,15 @@ from .errors import DNError
 
 
 def parser_for(fmt):
+    """Validate a datasource format name.
+
+    Contract: RETURNS (never raises) the parser token for a supported
+    format, or a DNError instance for anything else — the datasource
+    error-plumbing convention (create_datasource, _scan_init, and the
+    find layer all return DNError for config-shaped failures and let
+    the command layer raise).  Every call site must isinstance-check
+    the result; tests/test_ingest.py pins both halves of the
+    contract."""
     if fmt == 'json-skinner':
         return 'json-skinner'
     if fmt == 'json':
@@ -28,21 +37,35 @@ def parser_for(fmt):
 
 
 def iter_lines(paths, chunk_size=1 << 20):
-    """Yield decoded text lines from the concatenated contents of paths."""
-    buf = b''
+    """Yield decoded text lines from the concatenated contents of paths.
+
+    The carry between chunks is a *list* of chunk references, joined
+    only when a newline finally arrives — appending chunks to a bytes
+    buffer would re-copy the whole accumulated tail every read and go
+    quadratic on multi-MB single-line inputs."""
+    tail = []
     for path in paths:
         with open(path, 'rb') as f:
             while True:
                 chunk = f.read(chunk_size)
                 if not chunk:
                     break
-                buf += chunk
-                lines = buf.split(b'\n')
-                buf = lines.pop()
-                for line in lines:
+                nl = chunk.rfind(b'\n')
+                if nl == -1:
+                    tail.append(chunk)
+                    continue
+                head = chunk[:nl]
+                if tail:
+                    tail.append(head)
+                    head = b''.join(tail)
+                    tail = []
+                for line in head.split(b'\n'):
                     yield line
-    if buf:
-        yield buf
+                rest = chunk[nl + 1:]
+                if rest:
+                    tail.append(rest)
+    if tail:
+        yield b''.join(tail)
 
 
 def make_parser_stages(pipeline, fmt):

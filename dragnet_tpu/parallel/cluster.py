@@ -200,7 +200,13 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         of the index files (the _find override), then the partial
         aggregates merge across processes with the same allgather
         points reduce as scan — mirroring the reference's one-map-task-
-        per-index-file queries (lib/datasource-manta.js:392-433)."""
+        per-index-file queries (lib/datasource-manta.js:392-433).
+
+        Within each process the inherited file-backend query fans its
+        shard partition out over the DN_IQ_THREADS reader pool with
+        time-range pruning and the shard-handle cache
+        (index_query_mt), so the two parallelism axes compose:
+        partition across processes, pool within a process."""
         result = super(DatasourceCluster, self).query(
             query, interval, dry_run=dry_run)
         nprocs, pid = mod_dist.maybe_initialize()
@@ -219,6 +225,7 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         process topology, this process's input partition, and the local
         device mesh the sharded program would run over."""
         nprocs, pid = mod_dist.maybe_initialize()
+        from ..index_query_mt import iq_threads
         plan = {
             'backend': 'cluster',
             'phases': [
@@ -230,6 +237,9 @@ class DatasourceCluster(datasource_file.DatasourceFile):
             'nprocesses': nprocs,
             'process': pid,
             'partition': list(partition_files or []),
+            # index queries additionally fan out within the process
+            # (reader pool over the shard partition, index_query_mt)
+            'index_query_threads': iq_threads(),
         }
         # informational only — must never pay backend initialization
         # (over a tunneled device plugin the first probe can block for

@@ -1,0 +1,507 @@
+"""Parallel index-query fan-out (dragnet_tpu/index_query_mt.py):
+byte-identical to the sequential path for any DN_IQ_THREADS, time-range
+pruning derived from shard filenames, the shard-handle cache, and the
+premature-exit leak checks.
+
+The parity tests build real hour/day index trees in both storage
+formats (SQLite and DNC) from generated data whose key first-occurrence
+order varies across shards — the case a racy or out-of-order merge
+would scramble — and pin parallel output (points AND visible counters)
+to the sequential loop, with and without --before/--after bounds."""
+
+import io
+import json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import query as mod_query  # noqa: E402
+from dragnet_tpu import index_query_mt as mod_iqmt  # noqa: E402
+from dragnet_tpu import watchdog  # noqa: E402
+from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
+from dragnet_tpu.errors import DNError  # noqa: E402
+
+NDAYS = 14
+
+
+def _make_data(path, n=6000):
+    rng = random.Random(42)
+    with open(path, 'w') as f:
+        for i in range(n):
+            rec = {
+                'host': 'host%d' % rng.randrange(40),
+                'req': {'method': rng.choice(['GET', 'PUT', 'HEAD'])},
+                'operation': 'op%d' % rng.randrange(12),
+                'latency': rng.randrange(1, 2000),
+                'time': '2014-05-%02dT%02d:13:0%d.000Z'
+                        % (rng.randrange(1, NDAYS + 1),
+                           rng.randrange(24), rng.randrange(10)),
+            }
+            f.write(json.dumps(rec, separators=(',', ':')) + '\n')
+
+
+def _ds(datafile, idx):
+    return DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': datafile, 'timeField': 'time',
+                              'indexPath': idx},
+        'ds_filter': None, 'ds_format': 'json'})
+
+
+def _metric():
+    return mod_query.metric_deserialize({'name': 'm', 'breakdowns': [
+        {'name': 'ts', 'field': 'time', 'date': '', 'aggr': 'lquantize',
+         'step': 86400},
+        {'name': 'host', 'field': 'host'},
+        {'name': 'operation', 'field': 'operation'},
+        {'name': 'latency', 'field': 'latency', 'aggr': 'quantize'}]})
+
+
+def _query(after=None, before=None, filter=None):
+    conf = {'breakdowns': [{'name': 'host'},
+                           {'name': 'latency', 'aggr': 'quantize'}]}
+    if filter is not None:
+        conf['filter'] = filter
+    if after is not None:
+        conf['timeAfter'] = after
+        conf['timeBefore'] = before
+    q = mod_query.query_load(conf)
+    assert not isinstance(q, DNError), q
+    return q
+
+
+def _run_query(ds, threads, monkeypatch, **qargs):
+    monkeypatch.setenv('DN_IQ_THREADS', threads)
+    r = ds.query(_query(**qargs), 'day')
+    counters = [(s.name, {c: v for c, v in s.counters.items()
+                          if c not in s.hidden})
+                for s in r.pipeline.stages]
+    return r, counters
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    mod_iqmt.shard_cache_clear()
+    yield
+    mod_iqmt.shard_cache_clear()
+
+
+# -- shard filename time ranges -------------------------------------------
+
+def test_shard_time_range_day():
+    start, end = mod_iqmt.shard_time_range(
+        '/idx/by_day/2014-05-02.sqlite', '%Y-%m-%d.sqlite')
+    assert start == 1398988800000     # 2014-05-02T00:00:00Z
+    assert end - start == 86400000
+
+
+def test_shard_time_range_hour():
+    start, end = mod_iqmt.shard_time_range(
+        '2014-05-02-23.sqlite', '%Y-%m-%d-%H.sqlite')
+    assert end - start == 3600000
+    # 23h shard starts 23 hours into the day shard
+    day_start, _ = mod_iqmt.shard_time_range(
+        '2014-05-02.sqlite', '%Y-%m-%d.sqlite')
+    assert start == day_start + 23 * 3600000
+
+
+def test_shard_time_range_unparseable():
+    fmt = '%Y-%m-%d.sqlite'
+    assert mod_iqmt.shard_time_range('all', fmt) is None
+    assert mod_iqmt.shard_time_range('2014-13-40.sqlite', fmt) is None
+    assert mod_iqmt.shard_time_range('2014-05-02.dnc', fmt) is None
+    assert mod_iqmt.shard_time_range('x2014-05-02.sqlite', fmt) is None
+
+
+def test_prune_shards_window():
+    fmt = '%Y-%m-%d.sqlite'
+    paths = ['/i/2014-05-%02d.sqlite' % d for d in range(1, 11)]
+    paths.append('/i/not-a-shard')     # unparseable: never pruned
+    # [May 3, May 6): keeps shards 3,4,5 (+ the unparseable one)
+    after = mod_iqmt.shard_time_range('2014-05-03.sqlite', fmt)[0]
+    before = mod_iqmt.shard_time_range('2014-05-06.sqlite', fmt)[0]
+    kept, npruned = mod_iqmt.prune_shards(paths, fmt, after, before)
+    assert kept == ['/i/2014-05-%02d.sqlite' % d for d in (3, 4, 5)] + \
+        ['/i/not-a-shard']
+    assert npruned == 7
+    # no bounds / no layout: nothing pruned
+    assert mod_iqmt.prune_shards(paths, fmt, None, None) == (paths, 0)
+    assert mod_iqmt.prune_shards(paths, None, after, before) == \
+        (paths, 0)
+    # boundary shards overlap half-open [after, before)
+    kept, _ = mod_iqmt.prune_shards(
+        ['/i/2014-05-02.sqlite', '/i/2014-05-06.sqlite'], fmt,
+        after, before)
+    assert kept == []
+
+
+# -- parallel/sequential parity -------------------------------------------
+
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_parallel_matches_sequential(tmp_path, index_format,
+                                     monkeypatch):
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile)
+    _ds(datafile, idx).build([_metric()], 'day')
+
+    cases = [
+        {},
+        {'filter': {'eq': ['host', 'host7']}},
+        {'after': '2014-05-03', 'before': '2014-05-09'},
+        {'after': '2014-05-03T06:00:00', 'before': '2014-05-03T07:00:00',
+         'filter': {'ne': ['host', 'host3']}},
+    ]
+    ds = _ds(datafile, idx)
+    for qargs in cases:
+        r0, c0 = _run_query(ds, '0', monkeypatch, **qargs)
+        for threads in ('1', '4'):
+            r, c = _run_query(ds, threads, monkeypatch, **qargs)
+            assert r.points == r0.points, (threads, qargs)
+            assert c == c0, (threads, qargs)
+
+
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_cli_output_byte_identical(tmp_path, index_format, monkeypatch):
+    """Full CLI parity incl. --counters: `dn query` output under
+    --iq-threads=4 is byte-identical to --iq-threads=0."""
+    from parity.runner import DnRunner
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile)
+
+    r = DnRunner(tmp_path)
+    r.clear_config()
+    r.dn('datasource-add', 'input', '--path=' + datafile,
+         '--index-path=' + idx, '--time-field=time')
+    r.dn('metric-add', 'input', 'met', '-b',
+         'timestamp[date,field=time,aggr=lquantize,step=86400],host,'
+         'latency[aggr=quantize]')
+    r.dn('build', 'input')
+
+    for extra in ([], ['--counters'],
+                  ['--after', '2014-05-03', '--before', '2014-05-09',
+                   '--counters']):
+        runs = {}
+        for threads in ('0', '4'):
+            out, err, rc = r.run(['query', '--iq-threads=' + threads,
+                                  '-b', 'host'] + extra + ['input'])
+            assert rc == 0
+            runs[threads] = out + err
+        assert runs['0'] == runs['4'], extra
+
+
+def test_dnc_key_fast_path_matches_row_path(tmp_path, monkeypatch):
+    """The DNC engine's _execute_keys lane (grouped rows -> write_key
+    tuples) must aggregate byte-identically to the row-dict path it
+    bypasses, across plain, bucketized, time-bounded, and filtered
+    queries."""
+    from dragnet_tpu.index_dnc import DncIndexQuerier
+    monkeypatch.setenv('DN_INDEX_FORMAT', 'dnc')
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+
+    cases = [
+        {},
+        {'filter': {'eq': ['host', 'host1']}},
+        {'after': '2014-05-02', 'before': '2014-05-05'},
+    ]
+    for qargs in cases:
+        fast = ds.query(_query(**qargs), 'day').points
+        monkeypatch.setattr(DncIndexQuerier, '_execute_keys',
+                            lambda *a, **k: False)
+        slow = ds.query(_query(**qargs), 'day').points
+        monkeypatch.undo()
+        monkeypatch.setenv('DN_INDEX_FORMAT', 'dnc')
+        assert fast == slow, qargs
+
+
+# -- pruning counters ------------------------------------------------------
+
+def test_pruned_and_queried_counters(tmp_path, monkeypatch):
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    nshards = len(os.listdir(os.path.join(idx, 'by_day')))
+    assert nshards == NDAYS
+
+    def hidden_counters(result):
+        out = {}
+        for s in result.pipeline.stages:
+            for c in ('index shards pruned', 'index shards queried'):
+                if c in s.counters:
+                    out[c] = out.get(c, 0) + s.counters[c]
+        return out
+
+    # unbounded: every shard queried, nothing pruned
+    r = ds.query(_query(), 'day')
+    h = hidden_counters(r)
+    assert h.get('index shards queried') == nshards
+    assert h.get('index shards pruned', 0) == 0
+
+    # 3-day window: 3 queried, the rest pruned without being opened
+    r = ds.query(_query(after='2014-05-04', before='2014-05-07'), 'day')
+    h = hidden_counters(r)
+    assert h.get('index shards queried') == 3
+    assert h.get('index shards pruned') == nshards - 3
+
+    # the counters are hidden from the default --counters dump (golden
+    # byte-parity) but DN_COUNTERS_ALL=1 surfaces them
+    out = io.StringIO()
+    r.pipeline.dump_counters(out)
+    assert 'index shards' not in out.getvalue()
+    monkeypatch.setenv('DN_COUNTERS_ALL', '1')
+    out = io.StringIO()
+    r.pipeline.dump_counters(out)
+    assert 'index shards pruned' in out.getvalue()
+    assert 'index shards queried' in out.getvalue()
+
+
+# -- shard handle cache ----------------------------------------------------
+
+def test_cache_reuse_and_rebuild_invalidation(tmp_path, monkeypatch):
+    monkeypatch.setenv('DN_IQ_THREADS', '2')
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile, n=2000)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+
+    p1 = ds.query(_query(), 'day').points
+    stats = mod_iqmt.shard_cache_stats()
+    assert stats['misses'] > 0 and stats['size'] > 0
+    first_misses = stats['misses']
+
+    # warm: the serving workload reopens nothing
+    p2 = ds.query(_query(), 'day').points
+    stats = mod_iqmt.shard_cache_stats()
+    assert p2 == p1
+    assert stats['misses'] == first_misses
+    assert stats['hits'] >= stats['size']
+
+    # rebuild with different data: cached handles must not serve stale
+    # bytes (writer-side invalidation + stat identity)
+    _make_data(datafile, n=1000)
+    ds.build([_metric()], 'day')
+    p3 = ds.query(_query(), 'day').points
+    monkeypatch.setenv('DN_IQ_THREADS', '0')
+    p3_seq = ds.query(_query(), 'day').points
+    assert p3 == p3_seq
+    assert p3 != p1
+
+
+def test_empty_window_query(tmp_path, monkeypatch):
+    """A time window matching no shards must return an empty result
+    (not crash) for every thread count — regression: the executor
+    branch divided by a zero worker count when the find produced no
+    files."""
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile, n=500)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    qargs = {'after': '2020-01-01', 'before': '2020-01-02'}
+    for threads in ('0', '2'):
+        monkeypatch.setenv('DN_IQ_THREADS', threads)
+        r = ds.query(_query(**qargs), 'day')
+        assert r.points == [], threads
+
+
+def test_invalidate_while_leased_not_recached(tmp_path, monkeypatch):
+    """A handle leased across shard_cache_invalidate (the concurrent
+    in-process rebuild race) must not re-enter the cache at checkin."""
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile, n=500)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    shard = os.path.join(idx, 'by_day',
+                         sorted(os.listdir(os.path.join(idx,
+                                                        'by_day')))[0])
+    handle = mod_iqmt.checkout_shard(shard)
+    mod_iqmt.shard_cache_invalidate(shard)    # rebuild ran meanwhile
+    mod_iqmt.checkin_shard(handle)
+    assert mod_iqmt.shard_cache_stats()['size'] == 0
+    misses = mod_iqmt.shard_cache_stats()['misses']
+    h2 = mod_iqmt.checkout_shard(shard)       # fresh open, not stale
+    mod_iqmt.checkin_shard(h2)
+    assert mod_iqmt.shard_cache_stats()['misses'] == misses + 1
+
+
+def test_clear_while_leased_not_recached(tmp_path, monkeypatch):
+    """A handle leased across shard_cache_clear (clear-then-rmtree
+    while a query is in flight) must not re-enter the emptied
+    cache."""
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile, n=500)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    shard = os.path.join(idx, 'by_day',
+                         sorted(os.listdir(os.path.join(idx,
+                                                        'by_day')))[0])
+    handle = mod_iqmt.checkout_shard(shard)
+    mod_iqmt.shard_cache_clear()
+    mod_iqmt.checkin_shard(handle)
+    assert mod_iqmt.shard_cache_stats()['size'] == 0
+
+
+def test_single_shard_query_uses_cache(tmp_path, monkeypatch):
+    """Queries pruned (or found) down to one shard skip the pool but
+    still amortize open cost through the handle cache."""
+    monkeypatch.setenv('DN_IQ_THREADS', '2')
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile, n=1000)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    qargs = {'after': '2014-05-03', 'before': '2014-05-04'}
+    p1 = ds.query(_query(**qargs), 'day').points
+    stats = mod_iqmt.shard_cache_stats()
+    assert stats['size'] == 1
+    p2 = ds.query(_query(**qargs), 'day').points
+    assert p2 == p1
+    stats2 = mod_iqmt.shard_cache_stats()
+    assert stats2['misses'] == stats['misses']
+    assert stats2['hits'] == stats['hits'] + 1
+
+
+def test_cache_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv('DN_IQ_THREADS', '2')
+    monkeypatch.setenv('DN_IQ_CACHE', '0')
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile, n=1000)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    p1 = ds.query(_query(), 'day').points
+    p2 = ds.query(_query(), 'day').points
+    assert p1 == p2
+    stats = mod_iqmt.shard_cache_stats()
+    assert stats['size'] == 0 and stats['hits'] == 0
+
+
+def test_cache_eviction_bounds_open_handles(tmp_path, monkeypatch):
+    monkeypatch.setenv('DN_IQ_THREADS', '2')
+    monkeypatch.setenv('DN_IQ_CACHE', '4')
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    ds.query(_query(), 'day')
+    assert mod_iqmt.shard_cache_stats()['size'] <= 4
+
+
+def test_cache_smaller_than_tree_keeps_resident_prefix(tmp_path,
+                                                       monkeypatch):
+    """Cyclic full-tree sweeps wider than the cache must not thrash
+    the LRU to a 0% hit rate: hot entries reject admissions, so a
+    resident prefix keeps serving capacity/nshards of checkouts."""
+    monkeypatch.setenv('DN_IQ_THREADS', '1')
+    monkeypatch.setenv('DN_IQ_CACHE', '4')
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    p1 = ds.query(_query(), 'day').points
+    hits_before = mod_iqmt.shard_cache_stats()['hits']
+    p2 = ds.query(_query(), 'day').points
+    assert p2 == p1
+    stats = mod_iqmt.shard_cache_stats()
+    assert stats['size'] == 4
+    assert stats['hits'] - hits_before >= 4
+
+
+# -- error propagation -----------------------------------------------------
+
+def test_shard_error_deterministic(tmp_path, monkeypatch):
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile, n=1000)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    shards = sorted(os.listdir(os.path.join(idx, 'by_day')))
+    bad = os.path.join(idx, 'by_day', shards[2])
+    with open(bad, 'wb') as f:
+        f.write(b'garbage not an index at all')
+
+    messages = {}
+    for threads in ('0', '4'):
+        monkeypatch.setenv('DN_IQ_THREADS', threads)
+        with pytest.raises(DNError) as ei:
+            ds.query(_query(), 'day')
+        messages[threads] = ei.value.message
+    # same (first-in-find-order) error either way
+    assert messages['0'] == messages['4']
+    assert shards[2] in messages['0']
+
+
+# -- leak checks -----------------------------------------------------------
+
+def test_undrained_executor_fails_loudly(tmp_path):
+    ex = mod_iqmt.ShardQueryExecutor(_query(), 1)
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    assert 'index-query executor' in out.getvalue()
+    ex.close()
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    assert 'index-query executor' not in out.getvalue()
+
+
+def test_leaked_handle_fails_loudly(tmp_path, monkeypatch):
+    datafile = str(tmp_path / 'data.log')
+    idx = str(tmp_path / 'idx')
+    _make_data(datafile, n=500)
+    ds = _ds(datafile, idx)
+    ds.build([_metric()], 'day')
+    shard = os.path.join(idx, 'by_day',
+                         sorted(os.listdir(os.path.join(idx,
+                                                        'by_day')))[0])
+    handle = mod_iqmt.checkout_shard(shard)
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    assert 'index shard handle' in out.getvalue()
+    mod_iqmt.checkin_shard(handle)
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    assert 'index shard handle' not in out.getvalue()
+
+
+# -- thread-count resolution ----------------------------------------------
+
+def test_iq_threads_env(monkeypatch):
+    monkeypatch.delenv('DN_IQ_THREADS', raising=False)
+    monkeypatch.delenv('DN_QUERY_CONCURRENCY', raising=False)
+    auto = mod_iqmt.iq_threads()
+    assert 1 <= auto <= 6
+    monkeypatch.setenv('DN_IQ_THREADS', '0')
+    assert mod_iqmt.iq_threads() == 0
+    monkeypatch.setenv('DN_IQ_THREADS', '3')
+    assert mod_iqmt.iq_threads() == 3
+    monkeypatch.setenv('DN_IQ_THREADS', 'bogus')
+    assert mod_iqmt.iq_threads() == 0
+    # legacy alias: DN_QUERY_CONCURRENCY=1 meant "sequential"
+    monkeypatch.delenv('DN_IQ_THREADS', raising=False)
+    monkeypatch.setenv('DN_QUERY_CONCURRENCY', '1')
+    assert mod_iqmt.iq_threads() == 0
+    monkeypatch.setenv('DN_QUERY_CONCURRENCY', '8')
+    assert mod_iqmt.iq_threads() == 8
+    # unparseable legacy value fails open to auto (the pre-pool code
+    # ignored bad values), not to the slow sequential path
+    monkeypatch.setenv('DN_QUERY_CONCURRENCY', 'bogus')
+    assert mod_iqmt.iq_threads() == auto

@@ -1,0 +1,105 @@
+"""Ingest layer contracts: parser_for's return-an-error convention and
+the linear-time line assembly in iter_lines."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import ingest as mod_ingest  # noqa: E402
+from dragnet_tpu import query as mod_query  # noqa: E402
+from dragnet_tpu.datasource_file import DatasourceFile  # noqa: E402
+from dragnet_tpu.errors import DNError  # noqa: E402
+
+
+# -- parser_for: returns DNError, never raises ----------------------------
+
+def test_parser_for_contract():
+    assert mod_ingest.parser_for('json') == 'json'
+    assert mod_ingest.parser_for('json-skinner') == 'json-skinner'
+    err = mod_ingest.parser_for('csv')
+    assert isinstance(err, DNError)
+    assert err.message == 'unsupported format: "csv"'
+    # never raises, even for non-string garbage
+    assert isinstance(mod_ingest.parser_for(None), DNError)
+
+
+def test_parser_for_error_surfaces_at_scan(tmp_path):
+    """The one call site (_scan_init) isinstance-checks and re-raises:
+    a bad ds_format becomes a DNError from scan(), not a silent
+    non-error value."""
+    datafile = tmp_path / 'data.log'
+    datafile.write_text('{"a": 1}\n')
+    ds = DatasourceFile({
+        'ds_backend': 'file',
+        'ds_backend_config': {'path': str(datafile)},
+        'ds_filter': None, 'ds_format': 'tsv'})
+    q = mod_query.query_load({'breakdowns': [{'name': 'a'}]})
+    with pytest.raises(DNError) as ei:
+        ds.scan(q)
+    assert 'unsupported format: "tsv"' in ei.value.message
+
+
+# -- iter_lines ------------------------------------------------------------
+
+def _lines(paths, chunk_size):
+    return list(mod_ingest.iter_lines([str(p) for p in paths],
+                                      chunk_size=chunk_size))
+
+
+@pytest.mark.parametrize('chunk_size', [1, 2, 7, 1 << 20])
+def test_iter_lines_chunk_boundaries(tmp_path, chunk_size):
+    p = tmp_path / 'a'
+    p.write_bytes(b'one\ntwo\n\nfour')
+    assert _lines([p], chunk_size) == [b'one', b'two', b'', b'four']
+
+
+def test_iter_lines_concatenates_across_files(tmp_path):
+    """catstreams semantics: a partial trailing line joins across file
+    boundaries."""
+    a = tmp_path / 'a'
+    b = tmp_path / 'b'
+    a.write_bytes(b'start\npar')
+    b.write_bytes(b'tial\nend\n')
+    assert _lines([a, b], 4) == [b'start', b'partial', b'end']
+
+
+def test_iter_lines_trailing_newline_and_empty(tmp_path):
+    a = tmp_path / 'a'
+    a.write_bytes(b'x\n')
+    assert _lines([a], 1) == [b'x']
+    a.write_bytes(b'')
+    assert _lines([a], 1) == []
+    a.write_bytes(b'\n\n')
+    assert _lines([a], 1) == [b'', b'']
+
+
+def test_iter_lines_long_single_line_linear(tmp_path):
+    """Regression: a multi-MB single-line input must assemble in linear
+    time (the old `buf += chunk` re-copied the accumulated tail on
+    every read — quadratic)."""
+    p = tmp_path / 'big'
+    line = b'x' * (8 << 20)          # 8 MB, no newline until the end
+    p.write_bytes(line + b'\n' + b'tail')
+    t0 = time.monotonic()
+    got = _lines([p], 64 << 10)      # 128 chunk joins
+    elapsed = time.monotonic() - t0
+    assert got == [line, b'tail']
+    # the quadratic version copies ~0.5 GB here; linear assembly is
+    # well under a second even on a loaded machine
+    assert elapsed < 5.0
+
+
+def test_iter_lines_feeds_records(tmp_path):
+    p = tmp_path / 'r.log'
+    recs = [{'i': i} for i in range(100)]
+    p.write_text('\n'.join(json.dumps(r) for r in recs) + '\n')
+    got = list(mod_ingest.iter_records(
+        mod_ingest.iter_lines([str(p)], chunk_size=13), 'json'))
+    assert [f for f, v in got] == recs
+    assert all(v == 1 for f, v in got)
