@@ -4,7 +4,8 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test check bench bench-iq clean parity-matrix
+.PHONY: all native test check bench bench-iq bench-build clean \
+    parity-matrix
 
 all: native
 
@@ -29,6 +30,11 @@ bench: native
 # (sequential vs DN_IQ_THREADS pool, pruning, shard-handle cache)
 bench-iq: native
 	$(PYTHON) bench.py --iq-only
+
+# the build-path legs only: 365-shard index write (columnar blocks,
+# sequential vs DN_BUILD_THREADS shard writer pool)
+bench-build: native
+	$(PYTHON) bench.py --build-only
 
 # golden byte-parity under every engine (the strongest single seal:
 # host per-record, vectorized, forced device, auto router)

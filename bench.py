@@ -360,6 +360,93 @@ def index_query_bench(tmpdir):
     }
 
 
+def index_build_bench(tmpdir):
+    """Build-focused legs (`make bench-build` / --build-only): the
+    write side of the 365-shard daily tree index_query_bench reads.
+    Measures the full build (scan + index write, the figure
+    index_query_build_records_per_sec also reports) and then isolates
+    the index-write phase — per-metric columnar blocks are prepared
+    once, and index_build_mt.write_index_blocks is timed sequential
+    (DN_BUILD_THREADS=0) vs parallel (auto), p50/p95 over repeats."""
+    import shutil
+    from dragnet_tpu import index_build_mt as mod_ibmt
+    from dragnet_tpu import index_query_mt as mod_iqmt
+    datafile = os.path.join(tmpdir, 'build_year.log')
+    idx = os.path.join(tmpdir, 'build_year.idx')
+    n = 1000000
+    start_ms = 1388534400000             # 2014-01-01, 365 daily shards
+    end_ms = start_ms + 365 * 86400000
+    gen_to_file(n, datafile, mindate_ms=start_ms, maxdate_ms=end_ms)
+    ds = make_ds(datafile, idx)
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+
+    prior_bt = os.environ.pop('DN_BUILD_THREADS', None)
+    try:
+        # full build, default (parallel) writer pool
+        times = []
+        for _ in range(2):
+            shutil.rmtree(idx, ignore_errors=True)
+            t0 = time.monotonic()
+            ds.build(metrics, 'day')
+            times.append(time.monotonic() - t0)
+        build_s = min(times)
+        nshards = 0
+        for root, dirs, files in os.walk(idx):
+            nshards += len(files)
+
+        # prepare the columnar blocks once (untimed): the index-write
+        # phase is then measured alone, against the same inputs the
+        # build hands it
+        tagged = ds.index_scan(metrics, 'day').points
+        queries = [mod_query.metric_query(m, None, None, 'day', 'time')
+                   for m in metrics]
+        names = [[b['name'] for b in q.qc_breakdowns] for q in queries]
+        cols = [[[] for _ in nm] for nm in names]
+        weights = [[] for _ in metrics]
+        for fields, value in tagged:
+            mi = fields['__dn_metric']
+            for c, nm in zip(cols[mi], names[mi]):
+                c.append(fields[nm])
+            weights[mi].append(value)
+        blocks = [(names[mi], cols[mi], weights[mi])
+                  for mi in range(len(metrics))]
+        npoints = sum(len(w) for w in weights)
+
+        def timed_write(nworkers, reps):
+            out = []
+            for _ in range(reps):
+                shutil.rmtree(idx, ignore_errors=True)
+                t0 = time.monotonic()
+                mod_ibmt.write_index_blocks(metrics, 'day', idx, blocks,
+                                            nworkers=nworkers)
+                out.append((time.monotonic() - t0) * 1000)
+            out.sort()
+            return (out[len(out) // 2],
+                    out[min(len(out) - 1, int(len(out) * 0.95))])
+
+        seq_p50, seq_p95 = timed_write(0, 5)
+        par_n = mod_ibmt.build_threads()
+        par_p50, par_p95 = timed_write(par_n, 5)
+    finally:
+        if prior_bt is not None:
+            os.environ['DN_BUILD_THREADS'] = prior_bt
+        mod_iqmt.shard_cache_clear()
+        shutil.rmtree(idx, ignore_errors=True)
+        os.unlink(datafile)
+    return {
+        'index_build_records_per_sec': round(n / build_s),
+        'index_build_shards': nshards,
+        'index_build_points': npoints,
+        'index_build_threads': par_n,
+        'index_build_write_points_per_sec':
+            round(npoints / (par_p50 / 1000.0)) if par_p50 else None,
+        'index_build_write_sequential_p50_ms': round(seq_p50, 2),
+        'index_build_write_sequential_p95_ms': round(seq_p95, 2),
+        'index_build_write_parallel_p50_ms': round(par_p50, 2),
+        'index_build_write_parallel_p95_ms': round(par_p95, 2),
+    }
+
+
 def kernel_bench_extras(datafile):
     """Chip-level measurements (None values when no device backend)."""
     try:
@@ -524,10 +611,45 @@ def main_iq():
     }))
 
 
+def main_build():
+    """Index-build legs only (`make bench-build` / --build-only): the
+    write-path artifact without the scan/device legs."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_build_')
+    try:
+        ib = index_build_bench(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    seq = ib['index_build_write_sequential_p50_ms']
+    par = ib['index_build_write_parallel_p50_ms']
+    sys.stderr.write(
+        'bench-build: %d shards, %d points; full build %d rec/s; '
+        'index-write %s pts/s; shard-flush p50 parallel %.1fms '
+        '(seq %.1fms, %.1fx), p95 %.1f/%.1fms; threads %d\n'
+        % (ib['index_build_shards'], ib['index_build_points'],
+           ib['index_build_records_per_sec'],
+           ib['index_build_write_points_per_sec'], par, seq,
+           seq / par if par else 0.0,
+           ib['index_build_write_parallel_p95_ms'],
+           ib['index_build_write_sequential_p95_ms'],
+           ib['index_build_threads']))
+    print(json.dumps({
+        'metric': 'index_build_records_per_sec',
+        'value': ib['index_build_records_per_sec'],
+        'unit': 'records/s',
+        'vs_baseline': round(seq / par, 3) if par else None,
+        'extra': ib,
+    }))
+
+
 def main():
     if '--iq-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'iq':
         return main_iq()
+    if '--build-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'build':
+        return main_build()
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)

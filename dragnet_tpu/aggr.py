@@ -243,9 +243,11 @@ class Aggregator(object):
             seq.append(nn)
         return np.lexsort(tuple(seq))
 
-    def _columnar_points(self, as_rows):
+    def _columnar_cols(self, as_rows):
+        """Ordered, decoded output columns + weights (the shared tail
+        of points()/rows()/point_rows()): bucket-min values for
+        bucketized fields unless as_rows (rows carry ordinals)."""
         order = self._columnar_order()
-        n = len(order)
         cols_out = []
         for codes, dec, name in zip(self._cols, self._cdec,
                                     self.decomps):
@@ -278,6 +280,11 @@ class Aggregator(object):
             else:
                 weights = [int(w) if w.is_integer() else w
                            for w in wo.tolist()]
+        return cols_out, weights
+
+    def _columnar_points(self, as_rows):
+        cols_out, weights = self._columnar_cols(as_rows)
+        n = len(weights)
         if not as_rows and self.stage is not None:
             # (rows() never bumped noutputs on the flat path either)
             self.stage.bump('noutputs', n)
@@ -378,6 +385,39 @@ class Aggregator(object):
         get = flat.get
         for keys, value in items:
             flat[keys] = get(keys, 0) + value
+
+    def point_rows(self):
+        """The aggregate as columnar point blocks: (key columns,
+        weights) in points() emission order with bucketized fields
+        decoded to bucket-min values — exactly points() without the
+        per-point field dicts.  The index build consumes these blocks
+        directly (index_build_mt.write_index_blocks); stage counters
+        bump identically to points() so --counters output is
+        unchanged."""
+        if self._cols is None and \
+                len(self.flat) >= self.FLAT_COLUMNAR_MIN:
+            self._flat_to_columnar()
+        if self._cols is not None:
+            cols, weights = self._columnar_cols(False)
+            if self.stage is not None:
+                self.stage.bump('noutputs', len(weights))
+            return cols, weights
+        if not self.decomps:
+            if self.stage is not None:
+                self.stage.bump('noutputs')
+            return [], [self.total]
+        cols = [[] for _ in self.decomps]
+        weights = []
+        decs = [self.bucketizers.get(name) for name in self.decomps]
+        nout = 0
+        for keys, weight in self._walk():
+            for col, bz, k in zip(cols, decs, keys):
+                col.append(bz.bucket_min(k) if bz is not None else k)
+            weights.append(weight)
+            nout += 1
+        if self.stage is not None and nout:
+            self.stage.bump('noutputs', nout)
+        return cols, weights
 
     def points(self):
         """Aggregated points: fields carry bucket-min values for bucketized

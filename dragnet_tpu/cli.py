@@ -76,6 +76,10 @@ DN_OPTIONS = [
     (['backend'], 'string', None),
     (['before', 'B'], 'date', None),
     (['breakdowns', 'b'], 'arrayOfString', []),
+    # index-build writer pool override (not in USAGE_TEXT: the usage
+    # output is byte-pinned to the reference goldens; documented in
+    # docs/performance.md).  Equivalent to DN_BUILD_THREADS for one run.
+    (['build-threads'], 'string', None),
     (['counters'], 'bool', None),
     (['data-format'], 'string', 'json'),
     (['datasource'], 'string', None),
@@ -490,6 +494,40 @@ def dn_output(query, opts, result, dsname):
         pipeline.dump_counters(sys.stderr)
 
 
+def _pool_flag_env(optname, value, envname):
+    """Plumb a per-run worker-pool flag (--iq-threads,
+    --build-threads) through its env var for the duration of the
+    command: the datasource layer reads the env, and it must be
+    restored because the parity harness drives these entry points
+    in-process.  Unlike the env var, a bad explicit flag value is a
+    usage error, not a silent fallback to sequential."""
+    import contextlib
+    import os
+
+    if value is not None and value != 'auto':
+        try:
+            if int(value) < 0:
+                raise ValueError(value)
+        except ValueError:
+            raise UsageError('bad value for "%s": "%s"'
+                             % (optname, value))
+
+    @contextlib.contextmanager
+    def scope():
+        prior = os.environ.get(envname)
+        if value is not None:
+            os.environ[envname] = value
+        try:
+            yield
+        finally:
+            if value is not None:
+                if prior is None:
+                    os.environ.pop(envname, None)
+                else:
+                    os.environ[envname] = prior
+    return scope()
+
+
 def _warn_printer(stage, kind, error):
     sys.stderr.write('warn: %s\n' % (getattr(error, 'message', None) or
                                      str(error)))
@@ -527,32 +565,11 @@ def cmd_query(ctx, argv):
         fatal(ds)
     query = dn_query_config(opts)
 
-    # --iq-threads plumbs the shard fan-out width for this run only
-    # (the datasource layer reads DN_IQ_THREADS; restore it because
-    # the parity harness drives this entry point in-process).  Unlike
-    # the env var, a bad explicit flag value is a usage error, not a
-    # silent fallback to sequential.
-    if opts.iq_threads is not None and opts.iq_threads != 'auto':
+    with _pool_flag_env('iq-threads', opts.iq_threads, 'DN_IQ_THREADS'):
         try:
-            if int(opts.iq_threads) < 0:
-                raise ValueError(opts.iq_threads)
-        except ValueError:
-            raise UsageError('bad value for "iq-threads": "%s"'
-                             % opts.iq_threads)
-    import os
-    prior_iq = os.environ.get('DN_IQ_THREADS')
-    if opts.iq_threads is not None:
-        os.environ['DN_IQ_THREADS'] = opts.iq_threads
-    try:
-        result = ds.query(query, opts.interval, dry_run=opts.dry_run)
-    except DNError as e:
-        fatal(e)
-    finally:
-        if opts.iq_threads is not None:
-            if prior_iq is None:
-                os.environ.pop('DN_IQ_THREADS', None)
-            else:
-                os.environ['DN_IQ_THREADS'] = prior_iq
+            result = ds.query(query, opts.interval, dry_run=opts.dry_run)
+        except DNError as e:
+            fatal(e)
     dn_output(query, opts, result, dsname)
 
 
@@ -571,7 +588,7 @@ def _read_index_config(filename):
 def cmd_build(ctx, argv):
     opts = dn_parse_args(argv, ['after', 'before', 'counters', 'dry-run',
                                 'index-config', 'interval', 'warnings',
-                                'assetroot'])
+                                'assetroot', 'build-threads'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     indexcfg = _read_index_config(opts.index_config) \
@@ -592,12 +609,15 @@ def cmd_build(ctx, argv):
         fatal(DNError('no metrics defined for dataset "%s"' % dsname))
 
     warn_func = _warn_printer if getattr(opts, 'warnings', None) else None
-    try:
-        result = ds.build(metrics, opts.interval, time_after=opts.after,
-                          time_before=opts.before, dry_run=opts.dry_run,
-                          warn_func=warn_func)
-    except DNError as e:
-        fatal(e)
+    with _pool_flag_env('build-threads', opts.build_threads,
+                        'DN_BUILD_THREADS'):
+        try:
+            result = ds.build(metrics, opts.interval,
+                              time_after=opts.after,
+                              time_before=opts.before,
+                              dry_run=opts.dry_run, warn_func=warn_func)
+        except DNError as e:
+            fatal(e)
 
     if opts.dry_run:
         dn_output(None, opts, result, dsname)

@@ -25,7 +25,6 @@ from . import find as mod_find
 from .aggr import Aggregator
 from .scan import StreamScan
 from .vpipe import Pipeline
-from .index_sink import make_index_sink
 
 LOG = mod_log.get('datasource-file')
 
@@ -428,6 +427,23 @@ class DatasourceFile(object):
                 for s in scanners:
                     s.write(fields, value)
 
+        if sink == 'index':
+            # columnar hand-off: each metric's aggregate goes to the
+            # index writer as parallel key columns + weights
+            # (Aggregator.point_rows) — no per-point field dicts, no
+            # __dn_metric tagging pass (index_build_mt routes blocks
+            # by position)
+            from . import index_build_mt as mod_ibmt
+            blocks = []
+            for s in scanners:
+                if hasattr(s, 'finish'):
+                    s.finish()   # merge any device-buffered batches
+                cols, weights = s.aggr.point_rows()
+                blocks.append((list(s.aggr.decomps), cols, weights))
+            mod_ibmt.write_index_blocks(metrics, interval,
+                                        self.ds_indexpath, blocks)
+            return ScanResult(pipeline, points=None)
+
         tagged = []
         for qi, s in enumerate(scanners):
             if hasattr(s, 'finish'):
@@ -435,12 +451,7 @@ class DatasourceFile(object):
             for fields, value in s.aggr.points():
                 fields['__dn_metric'] = qi
                 tagged.append((fields, value))
-
-        if sink == 'points':
-            return ScanResult(pipeline, points=tagged)
-
-        self._index_write(metrics, interval, tagged)
-        return ScanResult(pipeline, points=None)
+        return ScanResult(pipeline, points=tagged)
 
     def _index_scan_native(self, queries, files, fmt, filter, pipeline):
         """Build fan-out over the native parser: ONE pass over raw bytes
@@ -786,61 +797,53 @@ class DatasourceFile(object):
         flush()
 
     def _index_write(self, metrics, interval, tagged_points):
-        """Write aggregated points into interval-chunked index files;
-        sinks are created lazily per time bucket and each file is written
-        atomically.  (reference: lib/datasource-file.js:444-547)"""
-        # rewritten shards must not serve from stale cached handles
-        # (in-process build-then-query, the serving refresh cycle)
-        from .index_query_mt import shard_cache_invalidate
+        """Write tagged aggregated points into interval-chunked index
+        files via the bulk write path; each file is written atomically
+        and failures leave no tmp litter.  (reference:
+        lib/datasource-file.js:444-547; the build path itself hands
+        columnar blocks straight to index_build_mt.write_index_blocks)"""
+        from . import index_build_mt as mod_ibmt
+        writer = mod_ibmt.StreamingIndexWriter(metrics, interval,
+                                               self.ds_indexpath)
+        try:
+            writer.write_points(tagged_points)
+            writer.finish()
+        except BaseException:
+            writer.abort()
+            raise
 
-        if interval == 'all':
-            allpath = os.path.join(self.ds_indexpath, 'all')
-            sink = make_index_sink(metrics, allpath)
-            for fields, value in tagged_points:
-                sink.write(fields, value)
-            sink.flush()
-            shard_cache_invalidate(allpath)
-            return
-
-        if interval == 'hour':
-            prefixlen = len('2014-07-02T00')
-            suffix = ':00:00Z'
-        elif interval == 'day':
-            prefixlen = len('2014-07-02')
-            suffix = 'T00:00:00Z'
-        else:
-            raise DNError('unsupported interval: "%s"' % interval)
-
-        root = os.path.join(self.ds_indexpath, 'by_' + interval)
-        sinks = {}
-        sinkpaths = {}
-        for fields, value in tagged_points:
-            dnts = fields['__dn_ts']
-            assert jsv.is_number(dnts)
-            datestr = jsv.to_iso_string(dnts * 1000)
-            bucketname = datestr[:prefixlen]
-            if bucketname not in sinks:
-                bucketstart = jsv.date_parse(bucketname + suffix) // 1000
-                label = bucketname.replace('T', '-')
-                indexpath = os.path.join(root, label + '.sqlite')
-                sinks[bucketname] = make_index_sink(
-                    metrics, indexpath, config={'dn_start': bucketstart})
-                sinkpaths[bucketname] = indexpath
-            sinks[bucketname].write(fields, value)
-        for bucketname, sink in sinks.items():
-            sink.flush()
-            shard_cache_invalidate(sinkpaths[bucketname])
+    # how many stdin points index_read routes to the sinks at a time:
+    # large enough to amortize the bulk write, small enough that peak
+    # memory stays flat however long the piped stream is
+    INDEX_READ_CHUNK = 4096
 
     def index_read(self, metrics, interval, instream):
         """Read tagged json-skinner points (from stdin) and write index
-        files.  (reference: lib/datasource-file.js:729-746)"""
+        files, streaming in bounded chunks — the old path materialized
+        the whole stream (bytes AND point dicts) before writing.
+        (reference: lib/datasource-file.js:729-746)"""
         error = self.check_index_args(interval, True, False)
         if error is not None:
             raise error
         pipeline = Pipeline()
-        points = [(f, v) for f, v in mod_ingest.iter_records(
-            _split_lines(instream), 'json-skinner', pipeline)]
-        self._index_write(metrics, interval, points)
+        from . import index_build_mt as mod_ibmt
+        writer = mod_ibmt.StreamingIndexWriter(metrics, interval,
+                                               self.ds_indexpath)
+        try:
+            chunk = []
+            for rec in mod_ingest.iter_records(
+                    mod_ingest.iter_stream_lines(instream),
+                    'json-skinner', pipeline):
+                chunk.append(rec)
+                if len(chunk) >= self.INDEX_READ_CHUNK:
+                    writer.write_points(chunk)
+                    chunk = []
+            if chunk:
+                writer.write_points(chunk)
+            writer.finish()
+        except BaseException:
+            writer.abort()
+            raise
         return ScanResult(pipeline)
 
     # -- query ------------------------------------------------------------
@@ -1072,11 +1075,3 @@ class _RemappedParser(object):
         return self.parser.strcodes_col(self.remap[path])
 
 
-def _split_lines(instream):
-    data = instream.read()
-    if isinstance(data, str):
-        data = data.encode()
-    lines = data.split(b'\n')
-    if lines and lines[-1] == b'':
-        lines.pop()
-    return lines

@@ -38,7 +38,8 @@ from . import jsvalues as jsv
 from . import native_index
 from .errors import DNError
 from .index_query import IndexQuerierBase
-from .index_sink import (IndexSink, INDEX_VERSION, metric_catalog_rows,
+from .index_sink import (IndexSink, INDEX_VERSION, check_block,
+                         metric_catalog_rows, point_metric, point_row,
                          sqlite3_escape)
 
 
@@ -169,6 +170,12 @@ class _NativeFileWriter(object):
         if rv != 0:
             raise DNError('index finalize failed')
 
+    def discard(self):
+        """Release the native handle without finalizing (error path)."""
+        if self.h is not None:
+            self.lib.dn_idx_writer_abort(self.h)
+            self.h = None
+
 
 class _PyFileWriter(object):
     """Same byte layout, plain Python I/O (no-toolchain fallback)."""
@@ -196,59 +203,97 @@ class _PyFileWriter(object):
         self.f.write(struct.pack('<qq', at, len(footer)))
         self.f.close()
 
+    def discard(self):
+        """Close without finalizing (error path)."""
+        try:
+            self.f.close()
+        except Exception:
+            pass
+
 
 class DncIndexSink(object):
     """Drop-in for index_sink.IndexSink writing the DNC format.
 
-    Points are buffered (their count is bounded by unique aggregate
-    tuples, the reference's own memory model) and columnarized at
-    flush; the file appears atomically via tmp+rename."""
+    Points are buffered columnarly — one Python list per column, plus
+    the value column — so the bulk write_rows path is a straight
+    list.extend with no per-row tuple objects; the buffer count stays
+    bounded by unique aggregate tuples, the reference's own memory
+    model.  Typed arrays are built at flush and the file appears
+    atomically via tmp+rename."""
 
-    def __init__(self, metrics, filename, config=None):
+    def __init__(self, metrics, filename, config=None, catalog=None):
         self.is_metrics = metrics
         self.is_dbfilename = filename
         self.is_dbtmpfilename = filename + '.' + str(os.getpid())
         self.is_config = dict(config or {})
         self.is_nwritten = 0
-        self._rows = [[] for _ in metrics]
+        self._catalog = catalog
         self._names = [[b['b_name'] for b in m.m_breakdowns]
                        for m in metrics]
+        self._keycols = [[[] for _ in names] for names in self._names]
+        self._vals = [[] for _ in metrics]
 
         dirname = os.path.dirname(self.is_dbtmpfilename)
         if dirname:
             os.makedirs(dirname, exist_ok=True)
 
     def write(self, fields, value):
-        # hot loop: one call per aggregated point; a missing breakdown
-        # raises KeyError like the SQLite sink's asserts would
-        mi = fields['__dn_metric']
-        if not (isinstance(mi, int) and mi >= 0):
-            raise IndexError('bad __dn_metric: %r' % (mi,))
-        self._rows[mi].append(
-            ([fields[name] for name in self._names[mi]], value))
+        # hot loop: one call per aggregated point
+        mi = point_metric(fields, len(self.is_metrics))
+        row = point_row(fields, self._names[mi])
+        for col, v in zip(self._keycols[mi], row):
+            col.append(v)
+        self._vals[mi].append(value)
         self.is_nwritten += 1
 
+    def write_rows(self, mi, keycols, values):
+        """Bulk append one metric's block: `keycols` is one column per
+        breakdown (in breakdown order), `values` the value column —
+        the direct columnar append the build fan-out uses."""
+        check_block(mi, keycols, self._names)
+        for col, src in zip(self._keycols[mi], keycols):
+            col.extend(src)
+        self._vals[mi].extend(values)
+        self.is_nwritten += len(values)
+
+    @staticmethod
+    def _array_of(raw):
+        """np.asarray that degrades to None instead of raising (huge
+        ints overflow, ragged values) — the vectorized fast paths
+        dispatch on the result's dtype and fall back per-element."""
+        try:
+            arr = np.asarray(raw)
+        except (ValueError, TypeError, OverflowError):
+            return None
+        return arr
+
     def _columnarize(self):
-        """Convert buffered rows to typed arrays; _Incompatible when a
-        value needs a storage class the column kind cannot hold."""
+        """Convert buffered columns to typed arrays; _Incompatible when
+        a value needs a storage class the column kind cannot hold."""
         tables = []
         for mi, m in enumerate(self.is_metrics):
-            rows = self._rows[mi]
-            n = len(rows)
+            rawvals = self._vals[mi]
+            n = len(rawvals)
             cols = []
             for ci, b in enumerate(m.m_breakdowns):
                 name = sqlite3_escape(b['b_name'])
+                raw = self._keycols[mi][ci]
                 if 'b_aggr' in b:
-                    arr = np.fromiter(
-                        (_int_affinity(r[0][ci]) for r in rows),
-                        dtype=np.int64, count=n)
+                    # the usual case — pure Python ints (bucket
+                    # ordinals, aggregated fields) — converts at C
+                    # speed; anything else (floats, bools, text,
+                    # out-of-range) takes the exact affinity loop
+                    arr = self._array_of(raw)
+                    if arr is None or arr.dtype != np.int64:
+                        arr = np.fromiter(
+                            (_int_affinity(v) for v in raw),
+                            dtype=np.int64, count=n)
                     cols.append((name, 'i64', arr))
                 else:
                     codes = np.empty(n, dtype=np.int32)
                     index = {}
                     values = []
-                    for i, r in enumerate(rows):
-                        t = r[0][ci]
+                    for i, t in enumerate(raw):
                         if type(t) is not str:  # fast path: usual case
                             t = _text_affinity(t)
                             if t is None:
@@ -261,30 +306,38 @@ class DncIndexSink(object):
                             values.append(t)
                         codes[i] = c
                     cols.append((name, 'str', (codes, values)))
-            vals = np.empty(n, dtype=np.float64)
-            flags = np.empty(n, dtype=np.uint8)
-            for i, r in enumerate(rows):
-                v = r[1]
-                if type(v) is int:  # fast path: integer weights
-                    vals[i] = v
-                    flags[i] = 1
-                else:
-                    vals[i], flags[i] = _value_affinity(v)
+            varr = self._array_of(rawvals)
+            if varr is not None and varr.dtype == np.int64:
+                # all-integer weights: INTEGER affinity, flags all set
+                vals = varr.astype(np.float64)
+                flags = np.ones(n, dtype=np.uint8)
+            elif varr is not None and varr.dtype == np.float64:
+                # int/float mix: same float64 image the per-element
+                # loop stored; integral (finite) values flag as ints,
+                # exactly _value_affinity's is_integer rule
+                vals = varr
+                flags = (np.isfinite(varr)
+                         & (varr == np.floor(varr))).astype(np.uint8)
+            else:
+                vals = np.empty(n, dtype=np.float64)
+                flags = np.empty(n, dtype=np.uint8)
+                for i, v in enumerate(rawvals):
+                    if type(v) is int:  # fast path: integer weights
+                        vals[i] = v
+                        flags[i] = 1
+                    else:
+                        vals[i], flags[i] = _value_affinity(v)
             tables.append((n, cols, vals, flags))
         return tables
 
     def _flush_sqlite(self):
         """A value needs a storage class DNC cannot hold: replay the
-        buffered rows into the SQLite engine instead (readers sniff per
-        file, so mixed trees work)."""
+        buffered columns into the SQLite engine instead (readers sniff
+        per file, so mixed trees work)."""
         sink = IndexSink(self.is_metrics, self.is_dbfilename,
-                         config=self.is_config)
-        for mi, m in enumerate(self.is_metrics):
-            for row, value in self._rows[mi]:
-                fields = {b['b_name']: v
-                          for b, v in zip(m.m_breakdowns, row)}
-                fields['__dn_metric'] = mi
-                sink.write(fields, value)
+                         config=self.is_config, catalog=self._catalog)
+        for mi in range(len(self.is_metrics)):
+            sink.write_rows(mi, self._keycols[mi], self._vals[mi])
         sink.flush()
 
     def flush(self):
@@ -306,49 +359,72 @@ class DncIndexSink(object):
         else:
             writer = _PyFileWriter(self.is_dbtmpfilename)
 
-        table_meta = []
-        for n, cols, vals, flags in tables:
-            cols_meta = []
-            for name, kind, data in cols:
-                if kind == 'i64':
-                    cols_meta.append({
-                        'name': name, 'kind': 'i64',
-                        'off': writer.block(data.tobytes())})
-                else:
-                    codes, values = data
-                    blobs = [_encode_text(s) for s in values]
-                    offsets = np.zeros(len(blobs) + 1, dtype=np.uint32)
-                    if blobs:
-                        offsets[1:] = np.cumsum(
-                            np.fromiter((len(x) for x in blobs),
-                                        dtype=np.uint32,
-                                        count=len(blobs)))
-                    cols_meta.append({
-                        'name': name, 'kind': 'str',
-                        'ndict': len(blobs),
-                        'codes_off': writer.block(codes.tobytes()),
-                        'doff_off': writer.block(offsets.tobytes()),
-                        'dbytes_off': writer.block(b''.join(blobs)),
-                        'dbytes_len': int(offsets[-1]),
-                    })
-            table_meta.append({
-                'nrows': n,
-                'columns': cols_meta,
-                'value_off': writer.block(vals.tobytes()),
-                'isint_off': writer.block(flags.tobytes()),
-            })
+        try:
+            table_meta = []
+            for n, cols, vals, flags in tables:
+                cols_meta = []
+                for name, kind, data in cols:
+                    if kind == 'i64':
+                        cols_meta.append({
+                            'name': name, 'kind': 'i64',
+                            'off': writer.block(data.tobytes())})
+                    else:
+                        codes, values = data
+                        blobs = [_encode_text(s) for s in values]
+                        offsets = np.zeros(len(blobs) + 1,
+                                           dtype=np.uint32)
+                        if blobs:
+                            offsets[1:] = np.cumsum(
+                                np.fromiter((len(x) for x in blobs),
+                                            dtype=np.uint32,
+                                            count=len(blobs)))
+                        cols_meta.append({
+                            'name': name, 'kind': 'str',
+                            'ndict': len(blobs),
+                            'codes_off': writer.block(codes.tobytes()),
+                            'doff_off': writer.block(offsets.tobytes()),
+                            'dbytes_off': writer.block(b''.join(blobs)),
+                            'dbytes_len': int(offsets[-1]),
+                        })
+                table_meta.append({
+                    'nrows': n,
+                    'columns': cols_meta,
+                    'value_off': writer.block(vals.tobytes()),
+                    'isint_off': writer.block(flags.tobytes()),
+                })
 
-        metrics_meta = [
-            {'id': mid, 'label': label, 'filter': filt, 'params': params}
-            for mid, label, filt, params in
-            metric_catalog_rows(self.is_metrics)]
-        footer = json.dumps({
-            'config': dict(configpairs),
-            'metrics': metrics_meta,
-            'tables': table_meta,
-        }).encode()
-        writer.finalize(footer)
-        os.rename(self.is_dbtmpfilename, self.is_dbfilename)
+            metrics_meta = [
+                {'id': mid, 'label': label, 'filter': filt,
+                 'params': params}
+                for mid, label, filt, params in
+                (self._catalog if self._catalog is not None
+                 else metric_catalog_rows(self.is_metrics))]
+            footer = json.dumps({
+                'config': dict(configpairs),
+                'metrics': metrics_meta,
+                'tables': table_meta,
+            }).encode()
+            writer.finalize(footer)
+            os.rename(self.is_dbtmpfilename, self.is_dbfilename)
+        except BaseException:
+            # crash hygiene: a failed serialization/rename must not
+            # leave `<name>.<pid>` behind
+            writer.discard()
+            self._discard_tmp()
+            raise
+
+    def abort(self):
+        """Discard the sink: drop the buffers and best-effort unlink
+        any tmp file a failed flush left mid-write."""
+        self._keycols = [[[] for _ in names] for names in self._names]
+        self._vals = [[] for _ in self.is_metrics]
+        self._discard_tmp()
+
+    def _discard_tmp(self):
+        try:
+            os.unlink(self.is_dbtmpfilename)
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------

@@ -9,12 +9,21 @@ fields and varchar(128) otherwise, plus a `value` column.
 
 Durability contract preserved: written to `<name>.<pid>`, fsync disabled
 (pragma synchronous=off), atomically renamed into place on flush
-(lib/index-sink.js:264-304) — a crash never leaves a torn index.
+(lib/index-sink.js:264-304) — a crash never leaves a torn index.  A
+*failed* flush (or abort()) best-effort unlinks the tmp file, so error
+paths leave the index directory clean too.
+
+Both storage engines share one error contract (point_metric/point_row):
+a bad __dn_metric tag or a missing breakdown raises DNError — the
+pre-PR-2 mix of bare asserts (stripped under -O) and IndexError is gone.
+Both also share the bulk write_rows(mi, key_columns, values) entry: one
+executemany per block here, a direct columnar append in the DNC sink.
 """
 
 import os
 import sqlite3
 
+from .errors import DNError
 from . import jsvalues as jsv
 from . import query as mod_query
 
@@ -23,6 +32,40 @@ INDEX_VERSION = '2.0.0'
 
 def sqlite3_escape(name):
     return name.replace('.', '_').replace('-', '_')
+
+
+def check_metric_index(mi, nmetrics):
+    """Validate a metric index; both storage engines raise the same
+    DNError for a missing/mistyped/out-of-range value."""
+    if not (isinstance(mi, int) and not isinstance(mi, bool)
+            and 0 <= mi < nmetrics):
+        raise DNError('bad __dn_metric: %r' % (mi,))
+    return mi
+
+
+def check_block(mi, keycols, names):
+    """Shared write_rows validation: metric index + one key column per
+    breakdown (`names` is the per-metric breakdown-name table)."""
+    check_metric_index(mi, len(names))
+    if len(keycols) != len(names[mi]):
+        raise DNError('write_rows: expected %d key columns, got %d'
+                      % (len(names[mi]), len(keycols)))
+
+
+def point_metric(fields, nmetrics):
+    """The validated __dn_metric tag of a tagged point."""
+    return check_metric_index(fields.get('__dn_metric'), nmetrics)
+
+
+def point_row(fields, names):
+    """A point's breakdown values in column order; a missing breakdown
+    raises the shared DNError contract."""
+    row = []
+    for name in names:
+        if name not in fields:
+            raise DNError('point is missing breakdown "%s"' % name)
+        row.append(fields[name])
+    return row
 
 
 def metric_catalog_rows(metrics):
@@ -37,19 +80,24 @@ def metric_catalog_rows(metrics):
     return rows
 
 
-def make_index_sink(metrics, filename, config=None):
+def make_index_sink(metrics, filename, config=None, catalog=None):
     """Index writer for the configured format: DN_INDEX_FORMAT=dnc (the
     native columnar store, default) or sqlite (reference-compatible
-    files).  Readers dispatch on file content, so either is queryable."""
+    files).  Readers dispatch on file content, so either is queryable.
+    `catalog` is an optional precomputed metric_catalog_rows(metrics) —
+    a 365-shard build serializes the identical catalog into every
+    shard, so the caller computes it once."""
     fmt = os.environ.get('DN_INDEX_FORMAT', 'dnc')
     if fmt == 'sqlite':
-        return IndexSink(metrics, filename, config=config)
+        return IndexSink(metrics, filename, config=config,
+                         catalog=catalog)
     from .index_dnc import DncIndexSink
-    return DncIndexSink(metrics, filename, config=config)
+    return DncIndexSink(metrics, filename, config=config,
+                        catalog=catalog)
 
 
 class IndexSink(object):
-    def __init__(self, metrics, filename, config=None):
+    def __init__(self, metrics, filename, config=None, catalog=None):
         self.is_metrics = metrics
         self.is_dbfilename = filename
         self.is_dbtmpfilename = filename + '.' + str(os.getpid())
@@ -60,7 +108,12 @@ class IndexSink(object):
         if dirname:
             os.makedirs(dirname, exist_ok=True)
 
-        self.is_db = sqlite3.connect(self.is_dbtmpfilename)
+        # check_same_thread=False: the build pool hands a sink to
+        # exactly one flush worker (index_build_mt), so a connection
+        # created on the streaming thread is later used — never
+        # concurrently — on another; serialized access makes it safe.
+        self.is_db = sqlite3.connect(self.is_dbtmpfilename,
+                                     check_same_thread=False)
         self.is_db.execute('pragma synchronous = off;')
 
         cur = self.is_db.cursor()
@@ -73,6 +126,7 @@ class IndexSink(object):
                     '    filter varchar(1024),\n'
                     '    params varchar(1024)\n);')
 
+        self._names = []
         self._insert_sql = []
         for i, m in enumerate(metrics):
             tblname = 'dragnet_index_%d' % i
@@ -84,6 +138,7 @@ class IndexSink(object):
             cols.append('    value integer')
             cur.execute('CREATE TABLE %s(\n%s\n);'
                         % (tblname, ',\n'.join(cols)))
+            self._names.append([b['b_name'] for b in m.m_breakdowns])
             self._insert_sql.append(
                 'INSERT INTO %s VALUES (%s)'
                 % (tblname, ', '.join('?' for _ in cols)))
@@ -96,22 +151,48 @@ class IndexSink(object):
                         configpairs)
 
         cur.executemany('INSERT INTO dragnet_metrics VALUES (?, ?, ?, ?)',
-                        metric_catalog_rows(metrics))
+                        catalog if catalog is not None
+                        else metric_catalog_rows(metrics))
 
     def write(self, fields, value):
         """Write one aggregated point; fields must carry __dn_metric."""
-        mi = fields['__dn_metric']
-        assert isinstance(mi, int) and 0 <= mi < len(self.is_metrics)
-        m = self.is_metrics[mi]
-        row = []
-        for b in m.m_breakdowns:
-            assert b['b_name'] in fields
-            row.append(fields[b['b_name']])
+        mi = point_metric(fields, len(self.is_metrics))
+        row = point_row(fields, self._names[mi])
         row.append(value)
         self.is_db.execute(self._insert_sql[mi], row)
         self.is_nwritten += 1
 
+    def write_rows(self, mi, keycols, values):
+        """Bulk append one metric's block: `keycols` is one column per
+        breakdown (in breakdown order), `values` the value column —
+        a single executemany, the whole sink committing as one
+        transaction at flush."""
+        check_block(mi, keycols, self._names)
+        self.is_db.executemany(self._insert_sql[mi],
+                               zip(*keycols, values))
+        self.is_nwritten += len(values)
+
     def flush(self):
-        self.is_db.commit()
-        self.is_db.close()
-        os.rename(self.is_dbtmpfilename, self.is_dbfilename)
+        try:
+            self.is_db.commit()
+            self.is_db.close()
+            os.rename(self.is_dbtmpfilename, self.is_dbfilename)
+        except BaseException:
+            self._discard_tmp()
+            raise
+
+    def abort(self):
+        """Discard the sink: close the connection and best-effort
+        unlink the tmp file (a failed build must not leave
+        `<name>.<pid>` litter behind)."""
+        try:
+            self.is_db.close()
+        except Exception:
+            pass
+        self._discard_tmp()
+
+    def _discard_tmp(self):
+        try:
+            os.unlink(self.is_dbtmpfilename)
+        except OSError:
+            pass

@@ -68,6 +68,36 @@ def iter_lines(paths, chunk_size=1 << 20):
         yield b''.join(tail)
 
 
+def iter_stream_lines(instream, chunk_size=1 << 20):
+    """Yield lines from an already-open (binary or text) stream in
+    bounded chunks — the stdin ingest path (`dn index-read`) must not
+    materialize the whole pipe.  Same linear-time carry discipline as
+    iter_lines; a trailing line without a newline is still yielded."""
+    tail = []
+    while True:
+        chunk = instream.read(chunk_size)
+        if not chunk:
+            break
+        if isinstance(chunk, str):
+            chunk = chunk.encode()
+        nl = chunk.rfind(b'\n')
+        if nl == -1:
+            tail.append(chunk)
+            continue
+        head = chunk[:nl]
+        if tail:
+            tail.append(head)
+            head = b''.join(tail)
+            tail = []
+        for line in head.split(b'\n'):
+            yield line
+        rest = chunk[nl + 1:]
+        if rest:
+            tail.append(rest)
+    if tail:
+        yield b''.join(tail)
+
+
 def make_parser_stages(pipeline, fmt):
     """Create the parse-layer pipeline stages eagerly so --counters output
     preserves the reference's stage order (parser before scan stages)."""

@@ -225,6 +225,7 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         process topology, this process's input partition, and the local
         device mesh the sharded program would run over."""
         nprocs, pid = mod_dist.maybe_initialize()
+        from ..index_build_mt import build_threads
         from ..index_query_mt import iq_threads
         plan = {
             'backend': 'cluster',
@@ -238,8 +239,11 @@ class DatasourceCluster(datasource_file.DatasourceFile):
             'process': pid,
             'partition': list(partition_files or []),
             # index queries additionally fan out within the process
-            # (reader pool over the shard partition, index_query_mt)
+            # (reader pool over the shard partition, index_query_mt),
+            # and index builds flush shards on the writer pool
+            # (index_build_mt)
             'index_query_threads': iq_threads(),
+            'index_build_threads': build_threads(),
         }
         # informational only — must never pay backend initialization
         # (over a tunneled device plugin the first probe can block for

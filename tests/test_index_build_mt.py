@@ -1,0 +1,387 @@
+"""Batched, parallel index build (dragnet_tpu/index_build_mt.py):
+byte-identical shards for any DN_BUILD_THREADS in both storage formats
+and all intervals, the unified sink error contract, crash hygiene (no
+tmp litter on failure), the bounded-memory streaming index-read path,
+and the premature-exit leak check."""
+
+import io
+import os
+import resource
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import index_build_mt as mod_ibmt  # noqa: E402
+from dragnet_tpu import index_query_mt as mod_iqmt  # noqa: E402
+from dragnet_tpu import query as mod_query  # noqa: E402
+from dragnet_tpu import watchdog  # noqa: E402
+from dragnet_tpu.errors import DNError  # noqa: E402
+from dragnet_tpu.index_dnc import DncIndexSink  # noqa: E402
+from dragnet_tpu.index_sink import IndexSink  # noqa: E402
+
+from test_index_query_mt import _make_data, _ds, _metric, _query  # noqa: E402
+
+
+def _metric2():
+    """A second metric so builds exercise the multi-metric fan-out."""
+    return mod_query.metric_deserialize({'name': 'm2', 'breakdowns': [
+        {'name': 'ts', 'field': 'time', 'date': '', 'aggr': 'lquantize',
+         'step': 3600},
+        {'name': 'req.method', 'field': 'req.method'}]})
+
+
+def _tree_bytes(idx):
+    out = {}
+    for root, dirs, files in os.walk(idx):
+        for f in files:
+            path = os.path.join(root, f)
+            with open(path, 'rb') as fh:
+                out[os.path.relpath(path, idx)] = fh.read()
+    return out
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    mod_iqmt.shard_cache_clear()
+    yield
+    mod_iqmt.shard_cache_clear()
+
+
+# -- parallel/sequential byte parity --------------------------------------
+
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+@pytest.mark.parametrize('interval', ['day', 'hour', 'all'])
+def test_parallel_build_byte_parity(tmp_path, index_format, interval,
+                                    monkeypatch):
+    """Shard bytes AND query output are identical for any worker
+    count, in both index formats, for every interval."""
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
+    datafile = str(tmp_path / 'data.log')
+    _make_data(datafile, n=3000)
+    metrics = [_metric(), _metric2()]
+
+    trees = {}
+    points = {}
+    for threads in ('0', '1', '4'):
+        monkeypatch.setenv('DN_BUILD_THREADS', threads)
+        idx = str(tmp_path / ('idx_' + threads))
+        ds = _ds(datafile, idx)
+        ds.build(metrics, interval)
+        trees[threads] = _tree_bytes(idx)
+        points[threads] = ds.query(_query(), interval).points
+
+    assert sorted(trees['0']) == sorted(trees['4'])
+    for threads in ('1', '4'):
+        assert trees[threads] == trees['0'], threads
+        assert points[threads] == points['0'], threads
+    nshards = len(trees['0'])
+    assert nshards == {'day': 14, 'all': 1}.get(interval, nshards)
+    if interval == 'hour':
+        assert nshards > 14
+
+
+def test_cli_build_threads_byte_identical(tmp_path, monkeypatch):
+    """`dn build --build-threads=4` produces the same index tree (and
+    query output) as --build-threads=0, and restores the env var."""
+    from parity.runner import DnRunner
+    monkeypatch.setenv('DN_INDEX_FORMAT', 'dnc')
+    monkeypatch.delenv('DN_BUILD_THREADS', raising=False)
+    datafile = str(tmp_path / 'data.log')
+    _make_data(datafile, n=2000)
+
+    r = DnRunner(tmp_path)
+    r.clear_config()
+    trees = {}
+    outs = {}
+    for threads in ('0', '4'):
+        idx = str(tmp_path / ('idx' + threads))
+        name = 'input' + threads
+        r.dn('datasource-add', name, '--path=' + datafile,
+             '--index-path=' + idx, '--time-field=time')
+        r.dn('metric-add', name, 'met', '-b',
+             'timestamp[date,field=time,aggr=lquantize,step=86400],'
+             'host,latency[aggr=quantize]')
+        out, err, rc = r.run(['build', '--build-threads=' + threads,
+                              name])
+        assert rc == 0, err
+        trees[threads] = _tree_bytes(idx)
+        outs[threads], _, _ = r.run(['query', '-b', 'host', name])
+    assert trees['0'] == trees['4']
+    assert outs['0'] == outs['4']
+    assert 'DN_BUILD_THREADS' not in os.environ
+
+    # a bad explicit flag value is a usage error
+    out, err, rc = r.run(['build', '--build-threads=bogus', 'input0'],
+                         check=False)
+    assert rc == 2 and 'build-threads' in err
+
+
+def test_index_read_matches_direct_build(tmp_path, monkeypatch):
+    """The streaming index-read path (chunked stdin points) writes the
+    same shard set as a direct build and answers queries identically —
+    the distributed-build seam, without needing the reference data."""
+    from dragnet_tpu import output as mod_output
+    monkeypatch.setenv('DN_INDEX_FORMAT', 'dnc')
+    datafile = str(tmp_path / 'data.log')
+    _make_data(datafile, n=2000)
+    metrics = [_metric()]
+
+    idx_direct = str(tmp_path / 'idx_direct')
+    ds = _ds(datafile, idx_direct)
+    ds.build(metrics, 'day')
+
+    scan = _ds(datafile, str(tmp_path / 'x')).index_scan(metrics, 'day')
+    buf = io.StringIO()
+    mod_output.print_points(scan.points, buf)
+
+    # tiny chunks so the bounded-chunk reassembly is really exercised
+    monkeypatch.setattr(type(ds), 'INDEX_READ_CHUNK', 7)
+    idx_via = str(tmp_path / 'idx_via')
+    ds2 = _ds(datafile, idx_via)
+    ds2.index_read(metrics, 'day', io.BytesIO(buf.getvalue().encode()))
+
+    assert _tree_bytes(idx_via) == _tree_bytes(idx_direct)
+    assert ds2.query(_query(), 'day').points == \
+        ds.query(_query(), 'day').points
+
+
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_index_read_empty_stream_writes_all_index(tmp_path,
+                                                  index_format,
+                                                  monkeypatch):
+    """An 'all'-interval index-read fed zero points must still write a
+    valid (empty) `all` index with the metric catalog — the per-point
+    path created that sink unconditionally, and a later `dn query -i
+    all` must answer with a zero result, not a missing-index error."""
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
+    idx = str(tmp_path / 'idx')
+    ds = _ds(str(tmp_path / 'none.log'), idx)
+    ds.index_read([_metric()], 'all', io.BytesIO(b''))
+    assert os.path.exists(os.path.join(idx, 'all'))
+    r = ds.query(_query(), 'all')
+    assert r.points == [({'host': 'null', 'latency': 0}, 0)] or \
+        r.points == []
+
+
+# -- unified sink error contract ------------------------------------------
+
+@pytest.mark.parametrize('sink_cls', [IndexSink, DncIndexSink])
+def test_sink_error_contract(tmp_path, sink_cls):
+    """Both storage engines raise the same DNError for a bad
+    __dn_metric or a missing breakdown (the SQLite sink used bare
+    asserts — stripped under -O; DNC used IndexError)."""
+    sink = sink_cls([_metric()], str(tmp_path / 'idx.sqlite'))
+    good = {'__dn_metric': 0, 'ts': 86400, 'host': 'a',
+            'operation': 'op', 'latency': 3}
+    for bad in (None, 'x', 1.5, True, -1, 7):
+        fields = dict(good, __dn_metric=bad)
+        if bad is None:
+            del fields['__dn_metric']
+        with pytest.raises(DNError, match='bad __dn_metric'):
+            sink.write(fields, 1)
+    missing = dict(good)
+    del missing['host']
+    with pytest.raises(DNError, match='missing breakdown "host"'):
+        sink.write(missing, 1)
+    # bulk entry: same tag contract, plus a column-arity check
+    with pytest.raises(DNError, match='bad __dn_metric'):
+        sink.write_rows(3, [[], [], [], []], [])
+    with pytest.raises(DNError, match='key columns'):
+        sink.write_rows(0, [[]], [])
+    sink.write(good, 1)
+    sink.flush()
+    assert os.path.exists(str(tmp_path / 'idx.sqlite'))
+
+
+# -- crash hygiene ---------------------------------------------------------
+
+def _assert_no_tmp(root):
+    for r, dirs, files in os.walk(root):
+        for f in files:
+            assert '.sqlite.' not in f and not f.split('.')[-1].isdigit(), \
+                'tmp file left behind: %s' % os.path.join(r, f)
+
+
+@pytest.mark.parametrize('sink_cls', [IndexSink, DncIndexSink])
+def test_failed_flush_leaves_no_tmp(tmp_path, sink_cls, monkeypatch):
+    idxdir = tmp_path / 'idx'
+    sink = sink_cls([_metric()], str(idxdir / 'x.sqlite'))
+    sink.write({'__dn_metric': 0, 'ts': 0, 'host': 'a',
+                'operation': 'op', 'latency': 3}, 1)
+
+    def boom(src, dst):
+        raise OSError('disk gone')
+    monkeypatch.setattr(os, 'rename', boom)
+    with pytest.raises(OSError):
+        sink.flush()
+    monkeypatch.undo()
+    assert os.listdir(str(idxdir)) == []
+
+
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_failed_build_leaves_index_dir_clean(tmp_path, index_format,
+                                             monkeypatch):
+    """A mid-build failure (here: one shard's rename blowing up) leaves
+    no `<name>.<pid>` litter anywhere in the tree, and the error is the
+    same for sequential and parallel builds."""
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
+    datafile = str(tmp_path / 'data.log')
+    _make_data(datafile, n=1500)
+    real_rename = os.rename
+
+    def flaky_rename(src, dst):
+        if '2014-05-03' in os.path.basename(str(dst)):
+            raise OSError('disk gone: %s' % os.path.basename(str(dst)))
+        return real_rename(src, dst)
+
+    messages = {}
+    for threads in ('0', '4'):
+        monkeypatch.setenv('DN_BUILD_THREADS', threads)
+        idx = str(tmp_path / ('idx' + threads))
+        monkeypatch.setattr(os, 'rename', flaky_rename)
+        with pytest.raises(OSError) as ei:
+            _ds(datafile, idx).build([_metric()], 'day')
+        monkeypatch.setattr(os, 'rename', real_rename)
+        messages[threads] = str(ei.value)
+        _assert_no_tmp(idx)
+    assert messages['0'] == messages['4']
+
+
+def test_streaming_abort_leaves_index_dir_clean(tmp_path, monkeypatch):
+    """A poisoned point mid-stream (bad __dn_metric) aborts index_read
+    with the contract DNError and unlinks every open sink's tmp."""
+    monkeypatch.setenv('DN_INDEX_FORMAT', 'sqlite')
+    idx = str(tmp_path / 'idx')
+    ds = _ds(str(tmp_path / 'none.log'), idx)
+    good = ('{"fields":{"__dn_ts":86400,"ts":86400,"host":"a",'
+            '"operation":"op","latency":3,"__dn_metric":0},"value":1}\n')
+    bad = good.replace('"__dn_metric":0', '"__dn_metric":9')
+    stream = io.BytesIO((good * 20 + bad).encode())
+    monkeypatch.setattr(type(ds), 'INDEX_READ_CHUNK', 4)
+    with pytest.raises(DNError, match='bad __dn_metric'):
+        ds.index_read([_metric()], 'day', stream)
+    _assert_no_tmp(idx)
+    assert os.listdir(os.path.join(idx, 'by_day')) == []
+
+
+# -- streaming memory ------------------------------------------------------
+
+class _PointStream(object):
+    """A json-skinner point stream produced on demand — nothing to
+    materialize, so any RSS growth is the reader's doing."""
+
+    def __init__(self, n):
+        self._gen = self._produce(n)
+        self._buf = b''
+        self._eof = False
+
+    @staticmethod
+    def _produce(n):
+        pad = 'x' * 120
+        for i in range(n):
+            ts = 86400 * (1 + i % 14)
+            yield ('{"fields":{"__dn_ts":%d,"ts":%d,"host":"h%d",'
+                   '"operation":"op%s","latency":%d,"__dn_metric":0},'
+                   '"value":1}\n'
+                   % (ts, ts, i % 5000, pad, i % 64)).encode()
+
+    def read(self, size=-1):
+        while not self._eof and (size < 0 or len(self._buf) < size):
+            try:
+                self._buf += next(self._gen)
+            except StopIteration:
+                self._eof = True
+        if size < 0:
+            out, self._buf = self._buf, b''
+        else:
+            out, self._buf = self._buf[:size], self._buf[size:]
+        return out
+
+
+def test_index_read_memory_stays_flat(tmp_path, monkeypatch):
+    """index_read streams stdin in bounded chunks: peak RSS on a large
+    piped build must not scale with the stream length (the old path
+    materialized all input bytes AND a dict per point — ~60 MB here)."""
+    monkeypatch.setenv('DN_INDEX_FORMAT', 'sqlite')
+    n = 150000
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    idx = str(tmp_path / 'idx')
+    ds = _ds(str(tmp_path / 'none.log'), idx)
+    result = ds.index_read([_metric()], 'day', _PointStream(n))
+    growth_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss \
+        - rss_before
+    nparsed = sum(s.counters.get('ninputs', 0)
+                  for s in result.pipeline.stages)
+    assert nparsed == n
+    assert len(os.listdir(os.path.join(idx, 'by_day'))) == 14
+    assert growth_kb < 40 * 1024, \
+        'RSS grew %d KB during streaming index_read' % growth_kb
+
+
+# -- executor: determinism and leak check ---------------------------------
+
+def test_flush_executor_first_error_in_bucket_order():
+    """Even when a later bucket fails first on the pool, the earliest
+    bucket-order error is the one re-raised."""
+    import time
+
+    def make(seq, fail, delay):
+        def task():
+            time.sleep(delay)
+            if fail:
+                raise RuntimeError('bucket %d' % seq)
+        return task
+
+    tasks = [make(0, False, 0.0), make(1, True, 0.05),
+             make(2, True, 0.0), make(3, False, 0.0)]
+    ex = mod_ibmt.SinkFlushExecutor(4)
+    with pytest.raises(RuntimeError, match='bucket 1'):
+        ex.run(tasks)
+    assert ex.closed
+
+
+def test_undrained_flush_executor_fails_loudly():
+    ex = mod_ibmt.SinkFlushExecutor(1)
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    assert 'index-build flush executor' in out.getvalue()
+    ex.close()
+    out = io.StringIO()
+    watchdog._run_checks(out)
+    assert 'index-build flush executor' not in out.getvalue()
+
+
+# -- bucketing -------------------------------------------------------------
+
+def test_bucket_starts_and_labels():
+    span = 86400
+    bs = mod_ibmt.bucket_starts([86400, 86401, 2 * 86400 - 1, 0], span)
+    assert bs.tolist() == [86400, 86400, 86400, 0]
+    assert mod_ibmt.bucket_label(86400, 'day') == '1970-01-02'
+    assert mod_ibmt.bucket_label(86400 + 3600 * 5, 'hour') == \
+        '1970-01-02-05'
+    # floats floor like the old to_iso_string prefix did
+    assert mod_ibmt.bucket_starts([86400.5], span).tolist() == [86400]
+    with pytest.raises(DNError, match='__dn_ts'):
+        mod_ibmt.bucket_starts(['not-a-number'], span)
+    with pytest.raises(DNError, match='unsupported interval'):
+        mod_ibmt.interval_span('week')
+
+
+# -- thread-count resolution ----------------------------------------------
+
+def test_build_threads_env(monkeypatch):
+    monkeypatch.delenv('DN_BUILD_THREADS', raising=False)
+    auto = mod_ibmt.build_threads()
+    assert 1 <= auto <= 6
+    monkeypatch.setenv('DN_BUILD_THREADS', '0')
+    assert mod_ibmt.build_threads() == 0
+    monkeypatch.setenv('DN_BUILD_THREADS', '3')
+    assert mod_ibmt.build_threads() == 3
+    monkeypatch.setenv('DN_BUILD_THREADS', 'bogus')
+    assert mod_ibmt.build_threads() == 0
+    monkeypatch.setenv('DN_BUILD_THREADS', 'auto')
+    assert mod_ibmt.build_threads() == auto
