@@ -26,8 +26,9 @@ check:
 bench: native
 	$(PYTHON) bench.py
 
-# the serving-path legs only: 365-shard index-query fan-out
-# (sequential vs DN_IQ_THREADS pool, pruning, shard-handle cache)
+# the serving-path legs only: 365-shard index-query execution
+# (stacked DN_IQ_STACK batch vs DN_IQ_THREADS per-shard pool vs
+# sequential, pruning, shard-handle cache)
 bench-iq: native
 	$(PYTHON) bench.py --iq-only
 

@@ -245,6 +245,11 @@ def timed_build(runs, name, datafile, nrecords, engine, repeats=2):
     return nrecords / best[0], best[1]
 
 
+def _iq_stack_mode():
+    from dragnet_tpu.index_query_stack import stack_mode
+    return stack_mode()
+
+
 def index_query_bench(tmpdir):
     """Many-shard index tree: 365 daily shards (the shape the
     reference's per-file fan-in was built for,
@@ -297,23 +302,33 @@ def index_query_bench(tmpdir):
             os.environ['DN_IQ_THREADS'] = threads
         return prior
 
+    def stack_env(mode):
+        prior = os.environ.get('DN_IQ_STACK')
+        if mode is None:
+            os.environ.pop('DN_IQ_STACK', None)
+        else:
+            os.environ['DN_IQ_STACK'] = mode
+        return prior
+
     # pin BOTH knobs: an ambient DN_QUERY_CONCURRENCY=1 (the old
     # harness's sequential override, a legacy alias for the pool size)
     # must not silently turn the parallel legs sequential
     prior_legacy = os.environ.pop('DN_QUERY_CONCURRENCY', None)
     prior_auto = iq_env('auto')
+    prior_stack = stack_env('auto')
     try:
-        # cold: pool fan-out, nothing cached yet (first query after a
-        # rebuild in a long-running server)
+        # cold: the shipping default (stacked), nothing cached yet
+        # (first query after a rebuild in a long-running server)
         mod_iqmt.shard_cache_clear()
         t0 = time.monotonic()
         ds.query(q(), 'day')
         cold_ms = (time.monotonic() - t0) * 1000
 
-        # parallel (default DN_IQ_THREADS=auto), warm handle cache —
-        # the serving workload
-        full_p50, full_p95 = measure(q(), 11)
-        win_p50, win_p95 = measure(
+        # stacked (default DN_IQ_STACK=auto), warm handle cache — the
+        # serving workload: shard blocks concatenate into one columnar
+        # batch, one vectorized filter+group-by (index_query_stack)
+        stk_p50, stk_p95 = measure(q(), 11)
+        stk_win_p50, stk_win_p95 = measure(
             q('2014-06-01', '2014-07-01'), 11)
         # shards-pruned observability: hidden per-stage counter on the
         # windowed query (365-shard tree, 30 in window)
@@ -324,6 +339,13 @@ def index_query_bench(tmpdir):
             queried += s.counters.get('index shards queried', 0)
         cache_stats = mod_iqmt.shard_cache_stats()
 
+        # per-shard parallel (PR 1's reader pool, DN_IQ_STACK=0) —
+        # the prior serving path, kept as a pinned column
+        stack_env('0')
+        par_p50, par_p95 = measure(q(), 11)
+        par_win_p50, par_win_p95 = measure(
+            q('2014-06-01', '2014-07-01'), 11)
+
         # sequential baseline: DN_IQ_THREADS=0 (uncached
         # open/query/close per shard — what every query paid before
         # the reader pool)
@@ -331,6 +353,7 @@ def index_query_bench(tmpdir):
         seq_p50, seq_p95 = measure(q(), 5)
     finally:
         iq_env(prior_auto)
+        stack_env(prior_stack)
         if prior_legacy is not None:
             os.environ['DN_QUERY_CONCURRENCY'] = prior_legacy
     mod_iqmt.shard_cache_clear()
@@ -341,15 +364,22 @@ def index_query_bench(tmpdir):
         'index_query_build_records_per_sec': round(n / build_s),
         # r1-r4 recorded a single-shard p50 (~0.8 ms); the comparable
         # figure here is per-shard, not the 365-shard total
-        'index_query_per_shard_ms': round(full_p50 / max(nshards, 1),
+        'index_query_per_shard_ms': round(stk_p50 / max(nshards, 1),
                                           3),
-        'index_query_p50_ms': round(full_p50, 2),
-        'index_query_p95_ms': round(full_p95, 2),
-        'index_query_parallel_p50_ms': round(full_p50, 2),
-        'index_query_parallel_p95_ms': round(full_p95, 2),
+        # headline = the shipping default path (stacked)
+        'index_query_p50_ms': round(stk_p50, 2),
+        'index_query_p95_ms': round(stk_p95, 2),
+        'index_query_stacked_p50_ms': round(stk_p50, 2),
+        'index_query_stacked_p95_ms': round(stk_p95, 2),
+        'index_query_stacked_window_p50_ms': round(stk_win_p50, 2),
+        'index_query_stacked_window_p95_ms': round(stk_win_p95, 2),
+        'index_query_parallel_p50_ms': round(par_p50, 2),
+        'index_query_parallel_p95_ms': round(par_p95, 2),
+        'index_query_parallel_window_p50_ms': round(par_win_p50, 2),
+        'index_query_parallel_window_p95_ms': round(par_win_p95, 2),
         'index_query_cold_ms': round(cold_ms, 2),
-        'index_query_window_p50_ms': round(win_p50, 2),
-        'index_query_window_p95_ms': round(win_p95, 2),
+        'index_query_window_p50_ms': round(stk_win_p50, 2),
+        'index_query_window_p95_ms': round(stk_win_p95, 2),
         'index_query_sequential_p50_ms': round(seq_p50, 2),
         'index_query_sequential_p95_ms': round(seq_p95, 2),
         'index_query_shards_pruned': pruned,
@@ -357,6 +387,7 @@ def index_query_bench(tmpdir):
         'index_query_cache_hits': cache_stats['hits'],
         'index_query_cache_misses': cache_stats['misses'],
         'index_query_threads': mod_iqmt.iq_threads(),
+        'index_query_stack_mode': _iq_stack_mode(),
     }
 
 
@@ -593,20 +624,25 @@ def main_iq():
         shutil.rmtree(tmpdir, ignore_errors=True)
     seq = iq['index_query_sequential_p50_ms']
     par = iq['index_query_parallel_p50_ms']
+    stk = iq['index_query_stacked_p50_ms']
     sys.stderr.write(
-        'bench-iq: %d shards; parallel p50 %.1fms (seq %.1fms, %.1fx); '
-        'window p50 %.1fms (%d pruned); cache %d hits / %d misses\n'
-        % (iq['index_query_shards'], par, seq,
-           seq / par if par else 0.0,
-           iq['index_query_window_p50_ms'],
+        'bench-iq: %d shards; stacked p50 %.1fms / parallel %.1fms / '
+        'seq %.1fms (%.1fx over parallel, %.1fx over seq); '
+        'window p50 stacked %.1fms parallel %.1fms (%d pruned); '
+        'cache %d hits / %d misses\n'
+        % (iq['index_query_shards'], stk, par, seq,
+           par / stk if stk else 0.0,
+           seq / stk if stk else 0.0,
+           iq['index_query_stacked_window_p50_ms'],
+           iq['index_query_parallel_window_p50_ms'],
            iq['index_query_shards_pruned'],
            iq['index_query_cache_hits'],
            iq['index_query_cache_misses']))
     print(json.dumps({
-        'metric': 'index_query_parallel_p50_ms',
-        'value': par,
+        'metric': 'index_query_stacked_p50_ms',
+        'value': stk,
         'unit': 'ms',
-        'vs_baseline': round(seq / par, 3) if par else None,
+        'vs_baseline': round(seq / stk, 3) if stk else None,
         'extra': iq,
     }))
 

@@ -42,6 +42,24 @@ def _is_array_index(s):
     return int(s) < 2 ** 32 - 1
 
 
+def coerce_bucket_value(v):
+    """The JS numeric coercion bucketized fields apply before
+    bucketize(): numeric strings coerce (the fixture data plants a
+    latency of "26" to pin this), anything non-coercible returns None
+    (drop the record).  THE single definition of the drop rule — the
+    per-record write() path, the DNC fast lane (_execute_keys), and
+    the stacked cross-shard path (index_query_stack) must agree on it
+    exactly, or their outputs diverge."""
+    if isinstance(v, str):
+        fv = jsv.to_number(v)
+        if fv != fv:
+            return None
+        return int(fv) if fv == int(fv) else fv
+    if not jsv.is_number(v):
+        return None
+    return v
+
+
 def js_key_order(keys):
     """Order keys the way V8 enumerates own properties: array-index-like
     keys ascending, then the rest in insertion order."""
@@ -83,16 +101,7 @@ class Aggregator(object):
         for name in self.decomps:
             v = jsv.pluck(fields, name)
             if name in self.bucketizers:
-                # Bucketizers use JS arithmetic, which coerces numeric
-                # strings (the fixture data plants a latency of "26" to
-                # pin this); anything non-coercible drops the record.
-                if isinstance(v, str):
-                    import math
-                    fv = jsv.to_number(v)
-                    v = None if math.isnan(fv) else \
-                        (int(fv) if fv == int(fv) else fv)
-                elif not jsv.is_number(v):
-                    v = None
+                v = coerce_bucket_value(v)
                 if v is None:
                     if self.stage is not None:
                         self.stage.warn(

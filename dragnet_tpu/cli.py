@@ -92,6 +92,10 @@ DN_OPTIONS = [
     # output is byte-pinned to the reference goldens; documented in
     # docs/performance.md).  Equivalent to DN_IQ_THREADS for one run.
     (['iq-threads'], 'string', None),
+    # stacked cross-shard index-query execution override (same
+    # rationale for staying out of USAGE_TEXT).  Equivalent to
+    # DN_IQ_STACK for one run: auto|0|1.
+    (['iq-stack'], 'string', None),
     (['index-path'], 'string', None),
     (['path'], 'string', None),
     (['points'], 'bool', None),
@@ -494,23 +498,13 @@ def dn_output(query, opts, result, dsname):
         pipeline.dump_counters(sys.stderr)
 
 
-def _pool_flag_env(optname, value, envname):
-    """Plumb a per-run worker-pool flag (--iq-threads,
-    --build-threads) through its env var for the duration of the
-    command: the datasource layer reads the env, and it must be
+def _env_scope(envname, value):
+    """Set `envname` for the duration of one command (None leaves it
+    untouched): the datasource layer reads the env, and it must be
     restored because the parity harness drives these entry points
-    in-process.  Unlike the env var, a bad explicit flag value is a
-    usage error, not a silent fallback to sequential."""
+    in-process."""
     import contextlib
     import os
-
-    if value is not None and value != 'auto':
-        try:
-            if int(value) < 0:
-                raise ValueError(value)
-        except ValueError:
-            raise UsageError('bad value for "%s": "%s"'
-                             % (optname, value))
 
     @contextlib.contextmanager
     def scope():
@@ -526,6 +520,28 @@ def _pool_flag_env(optname, value, envname):
                 else:
                     os.environ[envname] = prior
     return scope()
+
+
+def _pool_flag_env(optname, value, envname):
+    """Plumb a per-run worker-pool flag (--iq-threads,
+    --build-threads) through its env var for the duration of the
+    command.  Unlike the env var, a bad explicit flag value is a
+    usage error, not a silent fallback to sequential."""
+    if value is not None and value != 'auto':
+        try:
+            if int(value) < 0:
+                raise ValueError(value)
+        except ValueError:
+            raise UsageError('bad value for "%s": "%s"'
+                             % (optname, value))
+    return _env_scope(envname, value)
+
+
+def _mode_flag_env(optname, value, envname, allowed):
+    """_pool_flag_env for enumerated-mode flags (--iq-stack)."""
+    if value is not None and value not in allowed:
+        raise UsageError('bad value for "%s": "%s"' % (optname, value))
+    return _env_scope(envname, value)
 
 
 def _warn_printer(stage, kind, error):
@@ -557,7 +573,7 @@ def cmd_query(ctx, argv):
     opts = dn_parse_args(argv, ['before', 'after', 'filter', 'breakdowns',
                                 'raw', 'points', 'counters', 'interval',
                                 'gnuplot', 'assetroot', 'dry-run',
-                                'iq-threads'])
+                                'iq-threads', 'iq-stack'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     ds = datasource_for_name(ctx['config'], dsname)
@@ -565,7 +581,9 @@ def cmd_query(ctx, argv):
         fatal(ds)
     query = dn_query_config(opts)
 
-    with _pool_flag_env('iq-threads', opts.iq_threads, 'DN_IQ_THREADS'):
+    with _pool_flag_env('iq-threads', opts.iq_threads, 'DN_IQ_THREADS'), \
+            _mode_flag_env('iq-stack', opts.iq_stack, 'DN_IQ_STACK',
+                           ('auto', '0', '1')):
         try:
             result = ds.query(query, opts.interval, dry_run=opts.dry_run)
         except DNError as e:

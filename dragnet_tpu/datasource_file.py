@@ -861,6 +861,15 @@ class DatasourceFile(object):
                     None)
         return DNError('unsupported interval: "%s"' % interval)
 
+    def _cached_index_walk(self, root, pipeline):
+        """The unbounded index-tree walk, memoized on the directory's
+        stat identity (index_query_mt.cached_find_walk) — the cluster
+        backend overrides this to partition the cached listing across
+        processes, the same way its _find override partitions fresh
+        walks."""
+        from . import index_query_mt as mod_iqmt
+        return mod_iqmt.cached_find_walk(root, pipeline)
+
     def query(self, query, interval, dry_run=False):
         """Query the indexes.  (reference:
         lib/datasource-file.js:573-691)"""
@@ -877,7 +886,13 @@ class DatasourceFile(object):
             raise params
         root, timeformat, after, before = params
 
-        files = self._find(root, timeformat, after, before, pipeline)
+        if before is None and pipeline.warn_func is None:
+            # unbounded query over a flat index tree: the whole-tree
+            # walk (one stat per shard) is memoized on the directory's
+            # stat identity — stage counters replay byte-identically
+            files = self._cached_index_walk(root, pipeline)
+        else:
+            files = self._find(root, timeformat, after, before, pipeline)
         if isinstance(files, DNError):
             raise files
 
@@ -912,24 +927,39 @@ class DatasourceFile(object):
                   npruned=npruned, nworkers=nworkers,
                   interval=interval)
 
-        aggr_stage = aggr.stage
+        # Stacked cross-shard execution (index_query_stack, default):
+        # shard readers only LOAD matching column blocks, and one
+        # vectorized filter+group-by over the concatenated batch
+        # replaces the per-shard mask -> groupby -> merge loop —
+        # byte-identical output (the stacked lexsort reproduces the
+        # sequential insertion order exactly).  Falls back to the
+        # per-shard loop when the query shape or the exactness gate
+        # (non-integer weights) demands it, or under DN_IQ_STACK=0.
+        from . import index_query_stack as mod_iqs
+        stacked = False
+        if mod_iqs.stack_enabled() and mod_iqs.stack_eligible(query):
+            stacked = mod_iqs.run_stacked(paths, query, aggr,
+                                          index_list)
 
-        def merge(items):
-            # per-shard aggregates arrive as key items (the Aggregator
-            # wire format) in emission order: write_key replays them
-            # byte-identically to re-writing the shard's points.
-            # Counter parity with the per-point write() loop: one Index
-            # List input/output and one aggregator-stage input per
-            # point, bumped in bulk.
-            npts = len(items)
-            if npts == 0:
-                return
-            index_list.bump('ninputs', npts)
-            index_list.bump('noutputs', npts)
-            aggr_stage.bump('ninputs', npts)
-            aggr.merge_key_items(items)
+        if not stacked:
+            aggr_stage = aggr.stage
 
-        mod_iqmt.run_shard_queries(paths, query, nworkers, merge)
+            def merge(items):
+                # per-shard aggregates arrive as key items (the
+                # Aggregator wire format) in emission order: write_key
+                # replays them byte-identically to re-writing the
+                # shard's points.  Counter parity with the per-point
+                # write() loop: one Index List input/output and one
+                # aggregator-stage input per point, bumped in bulk.
+                npts = len(items)
+                if npts == 0:
+                    return
+                index_list.bump('ninputs', npts)
+                index_list.bump('noutputs', npts)
+                aggr_stage.bump('ninputs', npts)
+                aggr.merge_key_items(items)
+
+            mod_iqmt.run_shard_queries(paths, query, nworkers, merge)
 
         return ScanResult(pipeline, points=aggr.points(), query=query)
 

@@ -98,6 +98,153 @@ def _rate_field(r):
     except (TypeError, ValueError):
         return r
 
+
+# -- wedge armor: probe deadlines -------------------------------------------
+
+def probe_deadline_s():
+    """Deadline (seconds) for first-contact device operations —
+    DN_DEVICE_PROBE_TIMEOUT, the same knob bench.py's device_alive
+    probe honors.  The default must tolerate a cold tunneled plugin's
+    minutes-long first initialization without misclassifying it as
+    wedged."""
+    import os
+    try:
+        return float(os.environ.get('DN_DEVICE_PROBE_TIMEOUT', '420'))
+    except ValueError:
+        return 420.0
+
+
+def run_with_deadline(fn, seconds, what):
+    """bench.py's probe-deadline pattern as a library: run `fn` on a
+    daemon thread and wait at most `seconds`.  Returns ('ok', result),
+    ('error', exception), or ('timeout', None).  A wedged device
+    plugin hangs the daemon thread, not the caller; the abandoned
+    thread is leaked deliberately — there is no way to cancel a stuck
+    device op, and the process-exit path does not join daemons."""
+    box = []
+    done = threading.Event()
+
+    def _go():
+        try:
+            box.append(('ok', fn()))
+        except BaseException as e:
+            box.append(('error', e))
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_go, daemon=True,
+                         name='dn-deadline-%s' % what)
+    t.start()
+    done.wait(seconds)
+    if not box:
+        return ('timeout', None)
+    return box[0]
+
+
+# -- audition verdict cache --------------------------------------------------
+
+def _audition_cache_file():
+    """Path of the persisted audition-verdict cache, next to the XLA
+    compile cache (ops/__init__.py's DN_XLA_CACHE_DIR), or None when
+    disabled (DN_AUDITION_CACHE=0)."""
+    import os
+    if os.environ.get('DN_AUDITION_CACHE', '1') == '0':
+        return None
+    base = os.environ.get('DN_XLA_CACHE_DIR') or os.path.join(
+        os.path.expanduser('~'), '.cache', 'dragnet_tpu', 'xla')
+    return os.path.join(base, 'dn_auditions.json')
+
+
+def _audition_ttl_s():
+    """How long a persisted verdict stays trusted (DN_AUDITION_TTL_S,
+    default one day): rigs change — a tunnel gets faster, a host gets
+    busier — so verdicts age out rather than pinning a stale routing
+    decision forever."""
+    import os
+    try:
+        return float(os.environ.get('DN_AUDITION_TTL_S', '86400'))
+    except ValueError:
+        return 86400.0
+
+
+def _backend_id():
+    """Identity of the initialized backend for audition-cache keys: a
+    verdict measured against one chip (or transport) must not route a
+    different one."""
+    from .ops import get_jax
+    try:
+        jax, _ = get_jax()
+        dev = jax.devices()[0]
+        return '%s/%s' % (jax.default_backend(),
+                          getattr(dev, 'device_kind', '') or '')
+    except Exception:
+        return 'unknown'
+
+
+def audition_cache_get(key):
+    """The cached verdict for `key`: True (device won), False (device
+    lost), or None (no fresh entry).  All failures read as None — the
+    cache only ever skips work, never adds requirements."""
+    path = _audition_cache_file()
+    if path is None:
+        return None
+    import json
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        ent = data.get(key)
+        if not isinstance(ent, dict) or 'won' not in ent:
+            return None
+        if time.time() - float(ent.get('ts', 0)) > _audition_ttl_s():
+            return None
+        return bool(ent['won'])
+    except Exception:
+        return None
+
+
+def audition_cache_put(key, won, device_rate=None, host_rate=None):
+    """Persist an audition (or probation-crossover) verdict.  Expired
+    entries are pruned on write; the file is swapped atomically
+    (tmp+rename) so concurrent CLI invocations never read torn JSON.
+    Best-effort: an unwritable cache directory silently disables
+    persistence (the in-process decision already happened)."""
+    path = _audition_cache_file()
+    if path is None:
+        return
+    import json
+    import os
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                data = {}
+        except Exception:
+            data = {}
+        now = time.time()
+        ttl = _audition_ttl_s()
+        data = {k: v for k, v in data.items()
+                if isinstance(v, dict)
+                and now - float(v.get('ts', 0)) <= ttl}
+        data[key] = {'won': bool(won), 'ts': now,
+                     'device_rate': _rate_field(device_rate),
+                     'host_rate': _rate_field(host_rate)}
+        tmp = '%s.%d' % (path, os.getpid())
+        try:
+            with open(tmp, 'w') as f:
+                json.dump(data, f)
+            os.rename(tmp, path)
+        except Exception:
+            # crash hygiene (the index sinks' tmp contract): a failed
+            # write/rename must not strand `<name>.<pid>` litter
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    except Exception:
+        pass
+
 # jitted scan programs are shared across DeviceScan instances (a CLI
 # `dn scan` and the bench's repeat runs would otherwise re-trace and
 # re-compile identical programs per scan); keyed by the full static
@@ -565,9 +712,26 @@ class DeviceScan(VectorScan):
 
     def _probe_backend(self):
         """One-time lazy backend probe (first batch past the escalation
-        threshold).  False permanently disables the device path."""
-        ok = self._probe_ok()
-        LOG.debug('backend probe', ok=ok,
+        threshold).  False permanently disables the device path.
+
+        Wedge armor: the probe — the scan's first device op — runs
+        under the bench probe deadline (DN_DEVICE_PROBE_TIMEOUT).  A
+        hung device plugin under DN_ENGINE=jax used to hang `dn scan`
+        indefinitely here; now it warns and falls back to the host
+        engine, which computes identical results."""
+        status, ok = run_with_deadline(self._probe_ok,
+                                       probe_deadline_s(),
+                                       'backend-probe')
+        if status == 'timeout':
+            import sys
+            sys.stderr.write(
+                'dn: warning: device backend unresponsive (no answer '
+                'within %.0fs); falling back to the host engine\n'
+                % probe_deadline_s())
+            ok = False
+        elif status == 'error':
+            ok = False
+        LOG.debug('backend probe', ok=ok, status=status,
                   records_seen=self._records_seen)
         self._backend_ok = ok
         if not ok:
@@ -616,11 +780,20 @@ class DeviceScan(VectorScan):
                      host_rate=_rate_field(self._host_rate),
                      window_records=seen,
                      window_seconds=round(elapsed, 3))
+            # a measured crossover loss is a verdict too: persist it so
+            # the next identically-shaped run skips the whole detour
+            # (auto mode overrides; forced mode has no probation)
+            self._record_crossover(False, rate)
         else:
             LOG.debug('device passed probation',
                       device_rate=_rate_field(rate),
                       host_rate=_rate_field(self._host_rate))
         self._probation = False
+
+    def _record_crossover(self, won, rate):
+        """Hook: a probation-window crossover measurement concluded.
+        The base scan keeps no persistent state; AutoDeviceScan
+        persists the verdict in the audition cache."""
 
     def finish(self):
         sp = getattr(self, '_shadow', None)
@@ -1544,10 +1717,12 @@ class DeviceScan(VectorScan):
                 else:
                     specs[k] = SP()   # lookup tables: replicated
             sargs = {k: args[k] for k in specs}
-            return jax.shard_map(
+            from .ops import shard_map_compat
+            shard_map, vma_kwarg = shard_map_compat()
+            return shard_map(
                 lambda a: body(a, use_pallas), mesh=mesh,
                 in_specs=(specs,), out_specs=(SP(), SP(), SP()),
-                check_vma=not use_pallas)(sargs)
+                **{vma_kwarg: not use_pallas})(sargs)
 
         def fold(args, acc, use_pallas):
             """One batch folded into the device-resident accumulator:
@@ -2257,6 +2432,29 @@ class AutoDeviceScan(DeviceScan):
         if sp is not None and not sp.done:
             sp.feed(snap, n)
 
+    def _audition_key(self):
+        """Cache key of this scan's audition: the program-shaping query
+        structure (breakdown plans, predicate ASTs, synthetic fields,
+        time-boundedness) plus the backend identity — the pair that
+        determines which side wins on a given rig."""
+        plans = [(p.kind, p.name, p.field, p.step)
+                 for p in (self._plans or [])]
+        shape = jsv.json_stringify([
+            plans,
+            jsv.json_stringify(self.ds_pred.ast)
+            if self.ds_pred is not None else None,
+            jsv.json_stringify(self.user_pred.ast)
+            if self.user_pred is not None else None,
+            [[s['name'], s['field']] for s in self.synthetic],
+            self.time_bounds is not None,
+        ])
+        return shape + '@' + _backend_id()
+
+    def _record_crossover(self, won, rate):
+        audition_cache_put(self._audition_key(), won,
+                           device_rate=rate,
+                           host_rate=self._host_rate)
+
     def _engage_device(self):
         if self._escalated:
             return bool(self._backend_ok)
@@ -2269,9 +2467,20 @@ class AutoDeviceScan(DeviceScan):
             if self._probe_thread is None:
                 self._probe_thread = threading.Thread(
                     target=self._async_probe, daemon=True)
+                self._probe_started = time.monotonic()
                 self._probe_thread.start()
             result = self._probe_result
             if result is None:
+                # wedge armor: a hung backend leaves the probe thread
+                # stuck forever — the scan already runs on the host,
+                # but give up (and say so) past the probe deadline so
+                # the audition machinery stops waiting on it
+                if time.monotonic() - self._probe_started > \
+                        probe_deadline_s():
+                    LOG.info('device backend probe exceeded deadline; '
+                             'staying on host',
+                             deadline_s=probe_deadline_s())
+                    self._disabled = True
                 return False     # still probing; host path continues
             self._probe_thread = None
             self._backend_ok = result
@@ -2284,29 +2493,54 @@ class AutoDeviceScan(DeviceScan):
         if ctx is not None:
             sp = self._shadow
             if sp is None:
-                LOG.debug('device audition started',
-                          records_seen=self._records_seen)
-                self._shadow = _ShadowProbe(*ctx)
-                return False
-            if not sp.done:
-                return False
-            if sp.failed or sp.rate is None:
-                LOG.info('device audition failed; staying on host')
-                self._disabled = True
-                return False
-            hr = self._current_host_rate()
-            if hr is not None and sp.rate < hr * self.SHADOW_MARGIN:
-                LOG.info('device lost audition; staying on host',
+                # persisted verdict from a previous identically-shaped
+                # run on this backend: skip the ~5-batch shadow-probe
+                # warmup entirely (repeat CLI scans used to re-pay it
+                # every invocation, which made auto decline the device
+                # for every benchmark-sized job)
+                cached = audition_cache_get(self._audition_key())
+                if cached is False:
+                    LOG.info('cached audition verdict: device loses; '
+                             'staying on host')
+                    self._disabled = True
+                    return False
+                if cached is True:
+                    hr = self._current_host_rate()
+                    if hr is not None:
+                        self._host_rate = hr   # probation baseline
+                    LOG.info('cached audition verdict: device wins; '
+                             'taking over stream')
+                else:
+                    LOG.debug('device audition started',
+                              records_seen=self._records_seen)
+                    self._shadow = _ShadowProbe(*ctx)
+                    return False
+            else:
+                if not sp.done:
+                    return False
+                if sp.failed or sp.rate is None:
+                    LOG.info('device audition failed; staying on host')
+                    self._disabled = True
+                    return False
+                hr = self._current_host_rate()
+                if hr is not None and \
+                        sp.rate < hr * self.SHADOW_MARGIN:
+                    LOG.info('device lost audition; staying on host',
+                             device_rate=_rate_field(sp.rate),
+                             host_rate=_rate_field(hr),
+                             margin=self.SHADOW_MARGIN)
+                    audition_cache_put(self._audition_key(), False,
+                                       device_rate=sp.rate,
+                                       host_rate=hr)
+                    self._disabled = True
+                    return False
+                audition_cache_put(self._audition_key(), True,
+                                   device_rate=sp.rate, host_rate=hr)
+                if hr is not None:
+                    self._host_rate = hr   # probation baseline
+                LOG.info('device won audition; taking over stream',
                          device_rate=_rate_field(sp.rate),
-                         host_rate=_rate_field(hr),
-                         margin=self.SHADOW_MARGIN)
-                self._disabled = True
-                return False
-            if hr is not None:
-                self._host_rate = hr   # probation baseline
-            LOG.info('device won audition; taking over stream',
-                     device_rate=_rate_field(sp.rate),
-                     host_rate=_rate_field(hr))
+                         host_rate=_rate_field(hr))
         self._escalated = True
         LOG.info('escalated to device path',
                  records_seen=self._records_seen)

@@ -70,6 +70,28 @@ def _native_str_trans(column, parser_dict):
     return cache
 
 
+def fuse_codes(cols):
+    """One mixed-radix int64 key per row fusing equal-length int64
+    code columns (range-shifted per column), or None when the span
+    product could overflow int64 — THE shared fuse + overflow guard
+    (an off-by-one here corrupts every downstream sort/unique, so
+    there is exactly one copy).  Callers guard the empty case."""
+    n = len(cols[0])
+    spans = []
+    prod = 1
+    for arr in cols:
+        lo = int(arr.min())
+        span = int(arr.max()) - lo + 1
+        if prod > (2 ** 62) // max(span, 1):
+            return None
+        prod *= span
+        spans.append((lo, span))
+    fused = np.zeros(n, dtype=np.int64)
+    for arr, (lo, span) in zip(cols, spans):
+        fused = fused * span + (arr - lo)
+    return fused
+
+
 def _unique_rows(gcols):
     """Unique rows of a tuple of equal-length int64 code columns.
     Returns (first_idx, inv, order): first-occurrence index per unique
@@ -81,21 +103,8 @@ def _unique_rows(gcols):
     if n == 0:
         z = np.zeros(0, dtype=np.int64)
         return z, z, z
-    spans = []
-    prod = 1
-    ok = True
-    for arr in gcols:
-        lo = int(arr.min())
-        span = int(arr.max()) - lo + 1
-        if prod > (2 ** 62) // max(span, 1):
-            ok = False
-            break
-        prod *= span
-        spans.append((lo, span))
-    if ok:
-        fused = np.zeros(n, dtype=np.int64)
-        for arr, (lo, span) in zip(gcols, spans):
-            fused = fused * span + (arr - lo)
+    fused = fuse_codes(gcols)
+    if fused is not None:
         _, first_idx, inv = np.unique(fused, return_index=True,
                                       return_inverse=True)
     else:

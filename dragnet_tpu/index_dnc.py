@@ -743,6 +743,37 @@ class DncIndexQuerier(IndexQuerierBase):
                 np.asarray(sums, dtype=np.float64).tolist(),
                 np.asarray(flags).tolist())
 
+    def stack_blocks(self, table_ref, filt, groupby):
+        """Columnar block export for the stacked cross-shard path
+        (index_query_stack): evaluate the pushdown filter as a
+        vectorized mask and hand back the matching rows' raw columns —
+        no per-shard group-by; grouping happens once, across every
+        shard.  Returns (nrows, cols, values f64, isint u8) where each
+        groupby column is ('i64', int64 array) or ('dict', int64 codes
+        with -1 for NULL, dictionary entries as bytes, decoded
+        strings).  The selected arrays are copies (fancy indexing) and
+        the dictionary lists are immutable-object refs, so blocks stay
+        valid after the shard handle is checked back in (and possibly
+        evicted/closed) — required for the pool-loaded stacking."""
+        t = self._table(table_ref)
+        n = t['nrows']
+        mask = self._eval_mask(filt, t, n)
+        sel = np.nonzero(mask)[0]
+        cols = []
+        for name in groupby:
+            c = self._column(t, name)
+            if c['kind'] == 'i64':
+                cols.append(
+                    ('i64', self._view(c['off'], n, np.int64)[sel]))
+            else:
+                codes = self._codes(c, t)[sel].astype(np.int64)
+                entries = self._dict_entries(c)
+                cols.append(('dict', codes, entries,
+                             self._dict_strings(c, entries)))
+        values = self._view(t['value_off'], n, np.float64)[sel]
+        isint = self._view(t['isint_off'], n, np.uint8)[sel]
+        return (len(sel), cols, values, isint)
+
     def _execute(self, table_ref, filt, groupby):
         decoders, out_keys, sums, flags = self._grouped(
             table_ref, filt, groupby)
@@ -797,9 +828,8 @@ class DncIndexQuerier(IndexQuerierBase):
                 aggr.write_key((), int(s) if flags[0] else s)
             return True
 
+        from .aggr import coerce_bucket_value
         jsv_to_string = jsv.to_string
-        jsv_to_number = jsv.to_number
-        jsv_is_number = jsv.is_number
         bucketizers = [query.qc_bucketizers.get(b['name']) for b in bds]
         nkeys = len(groupby)
         for g in range(ngroups):
@@ -817,15 +847,7 @@ class DncIndexQuerier(IndexQuerierBase):
                     keys.append(v if type(v) is str
                                 else jsv_to_string(v))
                     continue
-                # mirror Aggregator.write's JS numeric coercion for
-                # bucketized fields exactly (numeric strings coerce,
-                # anything else drops the row)
-                if isinstance(v, str):
-                    fv = jsv_to_number(v)
-                    v = None if fv != fv else \
-                        (int(fv) if fv == int(fv) else fv)
-                elif not jsv_is_number(v):
-                    v = None
+                v = coerce_bucket_value(v)
                 if v is None:
                     dropped = True
                     break

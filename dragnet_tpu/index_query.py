@@ -268,6 +268,27 @@ class IndexQuerier(IndexQuerierBase):
         for row in cur.fetchall():
             yield dict(zip(names, row))
 
+    def stack_blocks(self, table, filt, groupby):
+        """Columnar block export for the stacked cross-shard path
+        (index_query_stack): the raw matching rows — no GROUP BY, no
+        SUM; grouping happens once, across every shard.  Returns
+        (nrows, [('obj', values_list)] per groupby column,
+        values_list, None) — raw Python row values so SQLite's
+        cross-type ordering and storage classes carry over exactly."""
+        columns = list(groupby)
+        columns.append('value')
+        sql = 'SELECT ' + ','.join(columns)
+        sql += ' from ' + table['table'] + ' '
+        sql += 'WHERE ' + _to_sql_string(filt)
+        try:
+            rows = self.qi_db.execute(sql).fetchall()
+        except sqlite3.Error as e:
+            raise DNError('executing query "%s"' % sql,
+                          cause=DNError(str(e)))
+        cols = [('obj', [r[k] for r in rows])
+                for k in range(len(groupby))]
+        return (len(rows), cols, [r[-1] for r in rows], None)
+
 
 def _json_parse_or_raise(text, label, what):
     try:

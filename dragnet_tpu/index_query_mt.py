@@ -376,10 +376,13 @@ def shard_cache_invalidate(path):
     call this after rewriting a shard, so in-process serving sees the
     new bytes even if the stat identity were to collide.  Handles
     currently leased to a worker are invalidated at checkin via the
-    per-path generation."""
+    per-path generation.  The shard-list cache for the containing
+    directory drops too (a rewrite may have ADDED the shard)."""
     with _CACHE_LOCK:
         _INVAL_GEN[path] = _INVAL_GEN.get(path, 0) + 1
         handle = _CACHE.pop(path, None)
+    with _FIND_LOCK:
+        _FIND_CACHE.pop(os.path.dirname(path), None)
     if handle is not None:
         handle.querier.close()
 
@@ -394,6 +397,8 @@ def shard_cache_clear():
         _EPOCH[0] += 1     # leased handles must not re-enter
         _CACHE_STATS['hits'] = 0
         _CACHE_STATS['misses'] = 0
+    with _FIND_LOCK:
+        _FIND_CACHE.clear()
     for handle in handles:
         handle.querier.close()
 
@@ -401,6 +406,51 @@ def shard_cache_clear():
 def shard_cache_stats():
     with _CACHE_LOCK:
         return dict(_CACHE_STATS, size=len(_CACHE))
+
+
+# -- shard-list (find) cache ----------------------------------------------
+
+# root directory -> (dir statkey, [(path, stat)], stage snapshot).
+# Unbounded queries walk the whole flat index tree — one os.stat per
+# shard, ~25 ms of syscalls on a 365-shard year — to produce a file
+# list the serving path then reads THROUGH the handle cache anyway.
+# The listing is a pure function of the directory, whose own stat
+# identity changes on every add/remove/rename within it (shard
+# rewrites land via tmp+rename), so one directory stat validates the
+# whole cached walk; in-process writers invalidate explicitly via
+# shard_cache_invalidate, same contract as the handle cache.
+_FIND_LOCK = threading.Lock()
+_FIND_CACHE = {}
+
+
+def cached_find_walk(root, pipeline):
+    """find_walk([root]) memoized on the directory's stat identity,
+    replaying the walk's pipeline stages and counters exactly (the
+    --counters bytes are pinned).  Only for the index-query path: the
+    cached per-file statbufs go stale (the query path never reads
+    them), and warn_func consumers must take the real walk."""
+    from . import find as mod_find
+    statkey = _statkey(root)
+    if statkey is not None:
+        with _FIND_LOCK:
+            cached = _FIND_CACHE.get(root)
+        if cached is not None and cached[0] == statkey:
+            _, files, stages = cached
+            for name, counters, hidden in stages:
+                stage = pipeline.stage(name)
+                stage.counters.update(counters)
+                stage.hidden.update(hidden)
+            return list(files)
+    nstages = len(pipeline.stages)
+    files = mod_find.find_walk([root], pipeline)
+    if statkey is not None:
+        stages = [(s.name, dict(s.counters), set(s.hidden))
+                  for s in pipeline.stages[nstages:]]
+        with _FIND_LOCK:
+            if len(_FIND_CACHE) >= 64:
+                _FIND_CACHE.pop(next(iter(_FIND_CACHE)))
+            _FIND_CACHE[root] = (statkey, list(files), stages)
+    return files
 
 
 # -- query execution ------------------------------------------------------
@@ -435,6 +485,57 @@ def _query_shard_cached(path, query):
         items = list(sub.key_items())
         ok = True
         return items
+    except DNError as e:
+        raise DNError('index "%s" query' % path, cause=e)
+    finally:
+        checkin_shard(handle, ok=ok)
+
+
+def _catalog_sig(querier):
+    """Identity of a querier's embedded metric catalog.  Computed once
+    per open handle (the handle cache keeps queriers hot, so warm
+    serving queries never recompute it): shards written by one build
+    share a byte-identical catalog, which lets the stacked loader
+    reuse one metric selection + composed filter across all of them
+    instead of re-running find_metric per shard."""
+    sig = getattr(querier, '_stack_catalog_sig', None)
+    if sig is None:
+        sig = tuple((m['qm_id'], m['qm_label'], m['qm_filter_raw'],
+                     repr(m['qm_params'])) for m in querier.qi_metrics)
+        querier._stack_catalog_sig = sig
+    return sig
+
+
+def _load_shard_blocks_cached(path, query, memo):
+    """Stacked-mode building block: lease a shard handle and load the
+    query's matching column blocks (querier.stack_blocks) instead of
+    executing a per-shard group-by.  `memo` caches the metric
+    selection / composed filter / groupby projection per catalog
+    signature for the duration of one fan-out (find_metric and the
+    filter deepcopy+escape are pure functions of (query, catalog)).
+    Error wrapping is identical to the query path: a bad open raises
+    DNError('index "<path>"') from checkout_shard, anything mid-load
+    DNError('index "<path>" query') — so a corrupt or truncated shard
+    reports the same way whichever execution mode hit it, and the
+    failed handle is closed (never re-cached) by the ok=False
+    checkin."""
+    handle = checkout_shard(path)
+    ok = False
+    try:
+        querier = handle.querier
+        plan = memo.get(_catalog_sig(querier))
+        if plan is None:
+            table = querier.find_metric(query)
+            if isinstance(table, DNError):
+                raise table
+            filt = querier._compose_filter(query, table)
+            groupby = querier._groupby_columns(query)
+            plan = (table, filt, groupby)
+            memo[_catalog_sig(querier)] = plan
+        table, filt, groupby = plan
+        blocks = querier.stack_blocks(table, filt, groupby)
+        ok = True
+        return blocks
     except DNError as e:
         raise DNError('index "%s" query' % path, cause=e)
     finally:
@@ -569,3 +670,21 @@ def run_shard_queries(paths, query, nworkers, on_items):
     else:
         ex = ShardQueryExecutor(query, min(nworkers, len(paths)))
         ex.run(paths, on_items)
+
+
+def run_shard_loads(paths, query, on_blocks):
+    """Stacked-mode shard fan-out: load every shard's matching column
+    blocks through the handle cache, calling on_blocks(blocks) once
+    per shard in find order.  Loads run on the CALLER's thread
+    deliberately: unlike full per-shard queries (whose per-group
+    Python work a pool overlaps), a block load is ~50 us of small-
+    array numpy that never releases the GIL, and measured on the
+    365-shard bench a reader pool made the stacked path ~1.5x SLOWER
+    (queue handoffs + GIL convoy), so DN_IQ_THREADS applies only to
+    the per-shard execution path.  Loads always go through the handle
+    cache — block loading exists only to feed the stacked aggregation,
+    so there is no uncached variant.  Error contract matches
+    run_shard_queries: the first failing shard in find order raises."""
+    memo = {}
+    for path in paths:
+        on_blocks(_load_shard_blocks_cached(path, query, memo))

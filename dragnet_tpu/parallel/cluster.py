@@ -102,6 +102,17 @@ class DatasourceCluster(datasource_file.DatasourceFile):
             files = mod_dist.partition_files(files, nprocs, pid)
         return files
 
+    def _cached_index_walk(self, root, pipeline):
+        """The memoized index-tree walk lists the WHOLE tree; this
+        process keeps only its partition, mirroring the _find
+        override."""
+        files = super(DatasourceCluster, self)._cached_index_walk(
+            root, pipeline)
+        nprocs, pid = mod_dist.maybe_initialize()
+        if nprocs > 1:
+            files = mod_dist.partition_files(files, nprocs, pid)
+        return files
+
     def _vector_scan_cls(self):
         return MeshDeviceScan
 
@@ -202,11 +213,16 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         points reduce as scan — mirroring the reference's one-map-task-
         per-index-file queries (lib/datasource-manta.js:392-433).
 
-        Within each process the inherited file-backend query fans its
-        shard partition out over the DN_IQ_THREADS reader pool with
-        time-range pruning and the shard-handle cache
-        (index_query_mt), so the two parallelism axes compose:
-        partition across processes, pool within a process."""
+        Within each process the inherited file-backend query stacks
+        its shard partition into one columnar batch and runs a single
+        vectorized filter+group-by over it (index_query_stack; the
+        DN_IQ_THREADS reader pool loads blocks, time-range pruning and
+        the shard-handle cache still apply, and under DN_ENGINE=jax
+        the per-tuple sums fold as one device scatter-add).  The
+        parallelism axes compose: partition across processes — each
+        process's stacked partial is a commutative aggregate — with
+        the allgather points reduce merging partials exactly, the same
+        monoid the psum merge exploits on the scan path."""
         result = super(DatasourceCluster, self).query(
             query, interval, dry_run=dry_run)
         nprocs, pid = mod_dist.maybe_initialize()
@@ -227,6 +243,7 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         nprocs, pid = mod_dist.maybe_initialize()
         from ..index_build_mt import build_threads
         from ..index_query_mt import iq_threads
+        from ..index_query_stack import stack_mode
         plan = {
             'backend': 'cluster',
             'phases': [
@@ -243,6 +260,12 @@ class DatasourceCluster(datasource_file.DatasourceFile):
             # and index builds flush shards on the writer pool
             # (index_build_mt)
             'index_query_threads': iq_threads(),
+            # stacked cross-shard execution mode (index_query_stack):
+            # each process stacks its own shard partition into one
+            # columnar batch (with the device scatter-add lane under
+            # DN_ENGINE=jax) and the partial aggregates merge across
+            # processes in the reduce phase
+            'index_query_stack': stack_mode(),
             'index_build_threads': build_threads(),
         }
         # informational only — must never pay backend initialization

@@ -41,7 +41,8 @@ def _sharded_aggregate_cached(radices, per_device, ndev, scatter,
                               integer_weights, use_pallas=False):
     jax, jnp = get_jax()
     from jax.sharding import Mesh, PartitionSpec as P
-    shard_map = jax.shard_map
+    from ..ops import shard_map_compat
+    shard_map, vma_kwarg = shard_map_compat()
 
     mesh = make_mesh()
     assert len(mesh.devices.flat) == ndev
@@ -89,7 +90,8 @@ def _sharded_aggregate_cached(radices, per_device, ndev, scatter,
     # variance, so the vma check must be off for that path only
     sharded = shard_map(step, mesh=mesh,
                         in_specs=(P(None, 'd'), P('d'), P('d')),
-                        out_specs=out_spec, check_vma=not use_pallas)
+                        out_specs=out_spec,
+                        **{vma_kwarg: not use_pallas})
     return jax.jit(sharded), mesh
 
 

@@ -22,5 +22,12 @@ if 'jax' in sys.modules:
     except Exception:
         pass
 
+# Hermeticity: the audition-verdict cache persists routing decisions
+# under ~/.cache between CLI runs by design, but tests that stage
+# wins/losses (test_auto_mode) must never see verdicts from a previous
+# test or a previous run.  Tests that exercise the cache itself opt
+# back in with DN_AUDITION_CACHE=1 and a tmp DN_XLA_CACHE_DIR.
+os.environ['DN_AUDITION_CACHE'] = '0'
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
