@@ -4,8 +4,8 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test check bench bench-iq bench-build clean \
-    parity-matrix
+.PHONY: all native test check bench bench-iq bench-build bench-parse \
+    clean parity-matrix
 
 all: native
 
@@ -37,13 +37,21 @@ bench-iq: native
 bench-build: native
 	$(PYTHON) bench.py --build-only
 
+# the parse-lane legs only: host-record vs native vs vector vs device
+# ingest MB/s + end-to-end scan rec/s per DN_PARSE lane (byteparse)
+bench-parse: native
+	$(PYTHON) bench.py --parse-only
+
 # golden byte-parity under every engine (the strongest single seal:
-# host per-record, vectorized, forced device, auto router)
+# host per-record, vectorized, forced device, auto router), then the
+# forced raw-byte ingest lane (DN_PARSE=vector) over the vector engine
 parity-matrix: native
 	@for e in host vector jax auto; do \
 	    echo "== DN_ENGINE=$$e =="; \
 	    DN_ENGINE=$$e $(PYTHON) -m pytest tests/parity/ -q || exit 1; \
 	done
+	@echo "== DN_PARSE=vector =="
+	@DN_PARSE=vector $(PYTHON) -m pytest tests/parity/ -q
 
 clean:
 	rm -rf native/build

@@ -53,6 +53,18 @@ QUERY = {
 
 HC_QUERY = {'breakdowns': [{'name': 'req.url'}, {'name': 'latency'}]}
 
+# flat-projection query for the parse-lane legs: every field path is
+# a top-level key, so the raw-byte lanes (DN_PARSE=vector|device) are
+# eligible and all four lanes answer the same scan
+PARSE_QUERY = {
+    'breakdowns': [
+        {'name': 'host'},
+        {'name': 'operation'},
+        {'name': 'latency', 'aggr': 'quantize'},
+    ],
+    'filter': {'ne': ['host', 'zzz']},
+}
+
 # small accumulator (16 x 32 segments): the one-hot MXU kernel's home
 # turf, used for the MFU measurement
 PALLAS_QUERY = {'breakdowns': [{'name': 'host'},
@@ -478,6 +490,131 @@ def index_build_bench(tmpdir):
     }
 
 
+def parse_bench_extras(datafile, nrecords, use_device,
+                       end_to_end=False):
+    """Parse-lane measurements on the dense corpus: MB/s for each
+    ingest lane over the same byte slice (DN_BENCH_PARSE_BYTES caps
+    the slice so the leg stays bounded), plus — with end_to_end — the
+    full `dn scan` rec/s per lane on the flat-projection PARSE_QUERY.
+
+    Lanes: `host` is the per-record reference parser (json.loads +
+    flat pluck — the path whose per-record dicts the byte lanes
+    delete); `native` is the C++ SIMD parser; `vector`/`device` are
+    the byteparse structural lanes (numpy / jax-staged kernel)."""
+    import json as mod_json
+    from dragnet_tpu import byteparse as mod_byteparse
+    from dragnet_tpu import native as mod_native
+
+    cap = int(os.environ.get('DN_BENCH_PARSE_BYTES', str(48 << 20)))
+    with open(datafile, 'rb') as f:
+        data = f.read(cap)
+    nl = data.rfind(b'\n')
+    data = data[:nl + 1]
+    nbytes = len(data)
+
+    paths = ['host', 'operation', 'latency']
+    hints = [False, False, False]
+    dicts = [True, True, True]
+
+    def feed_columnar(parser):
+        pos = 0
+        t0 = time.monotonic()
+        while pos < nbytes:
+            end = min(pos + (4 << 20), nbytes)
+            cut = data.rfind(b'\n', pos, end)
+            if cut < pos:
+                cut = end - 1
+            parser.parse(data[pos:cut + 1])
+            pos = cut + 1
+            if parser.batch_size() >= (1 << 20):
+                parser.reset_batch()
+        return nbytes / (time.monotonic() - t0) / 1e6
+
+    def best(fn, reps=2):
+        return max(fn() for _ in range(reps))
+
+    out = {'parse_bytes_measured': nbytes}
+
+    # host reference lane, equivalent work: json.loads + per-record
+    # conversion into the SAME tagged columnar batch (the byte
+    # parser's forced-fallback mode — literally the host parser the
+    # fast path falls back to)
+    out['parse_host_mb_per_sec'] = round(best(
+        lambda: feed_columnar(mod_byteparse.ByteParser(
+            paths, hints, dicts, force_fallback=True))), 1)
+    # raw json.loads + flat pluck into lists, for scale (no columnar
+    # conversion — the loosest possible host-parse reading)
+    lines = data.split(b'\n')
+    sample = lines[:min(len(lines), 200000)]
+    sbytes = sum(len(ln) + 1 for ln in sample)
+
+    def loads_only():
+        t0 = time.monotonic()
+        cols = {p: [] for p in paths}
+        ud = object()
+        for ln in sample:
+            try:
+                r = mod_json.loads(ln)
+            except ValueError:
+                continue
+            isdict = type(r) is dict
+            for p in paths:
+                cols[p].append(r.get(p, ud) if isdict else ud)
+        return sbytes / (time.monotonic() - t0) / 1e6
+    out['parse_loads_pluck_mb_per_sec'] = round(best(loads_only), 1)
+
+    if mod_native.get_lib() is not None:
+        out['parse_native_mb_per_sec'] = round(best(
+            lambda: feed_columnar(mod_native.NativeParser(
+                paths, hints, dicts))), 1)
+    else:
+        out['parse_native_mb_per_sec'] = None
+
+    last = {}
+
+    def vector_rate():
+        p = mod_byteparse.ByteParser(paths, hints, dicts)
+        last['p'] = p        # fallback counters come from a timed rep
+        return feed_columnar(p)
+    out['parse_vector_mb_per_sec'] = round(best(vector_rate), 1)
+    vec = last['p']
+    total_lines = vec.lines_fast + vec.lines_fb
+    out['parse_vector_fallback_pct'] = round(
+        100.0 * vec.lines_fb / max(total_lines, 1), 3)
+
+    from dragnet_tpu.ops import byteparse_kernels as bk
+    if use_device and bk.device_parity_available():
+        out['parse_device_mb_per_sec'] = round(best(
+            lambda: feed_columnar(mod_byteparse.ByteParser(
+                paths, hints, dicts, device=True))), 1)
+    else:
+        out['parse_device_mb_per_sec'] = None
+
+    if end_to_end:
+        runs = Runs()
+        q = dict(PARSE_QUERY)
+        prior = os.environ.get('DN_PARSE')
+        npts = {}
+        try:
+            for lane in ('host', 'vector') + (
+                    ('device',) if out['parse_device_mb_per_sec']
+                    is not None else ()):
+                os.environ['DN_PARSE'] = lane
+                rps, np_, _ = timed_scan(
+                    runs, 'parse_scan_' + lane, datafile, nrecords,
+                    q, 'vector', repeats=2)
+                out['parse_%s_records_per_sec' % lane] = round(rps)
+                npts[lane] = np_
+        finally:
+            if prior is None:
+                os.environ.pop('DN_PARSE', None)
+            else:
+                os.environ['DN_PARSE'] = prior
+        assert len(set(npts.values())) == 1, 'parse lanes diverge'
+        out['parse_runs'] = runs.summary()
+    return out
+
+
 def kernel_bench_extras(datafile):
     """Chip-level measurements (None values when no device backend)."""
     try:
@@ -612,6 +749,113 @@ def device_alive(timeout_s=None):
     return alive
 
 
+def main_device_legs(datafile, large_n):
+    """Run ONLY the device legs against an existing datafile and print
+    one JSON line — the re-exec target for wedge *recovery*: a fresh
+    process gets a fresh plugin initialization, so a wedge observed in
+    the parent doesn't have to null the whole artifact."""
+    if not device_alive():
+        print(json.dumps({'ok': False}))
+        return
+    runs = Runs()
+    device_large, np_dev, dev_batches = timed_scan(
+        runs, 'scan_large_device', datafile, large_n, QUERY, 'jax')
+    hc_dev, hc_tuples, hc_batches = timed_scan(
+        runs, 'highcard_device', datafile, large_n, HC_QUERY, 'jax',
+        repeats=2)
+    build_dev, build_stacked = timed_build(
+        runs, 'build_device', datafile, large_n, 'jax')
+    kb = kernel_bench_extras(datafile)
+    print(json.dumps({
+        'ok': True,
+        'device_large_records_per_sec': round(device_large),
+        'device_output_points': np_dev,
+        'device_batches': dev_batches,
+        'highcard_device_records_per_sec': round(hc_dev),
+        'highcard_output_tuples': hc_tuples,
+        'highcard_device_batches': hc_batches,
+        'build_device_records_per_sec': round(build_dev),
+        'build_device_stacked_batches': build_stacked,
+        'kernel_extras': kb,
+        'runs': runs.summary(),
+    }))
+
+
+def device_retry_subprocess(datafile, large_n):
+    """Wedge recovery: re-exec the device legs in a fresh subprocess
+    (fresh plugin init) and retry once before recording nulls.
+    Returns the subprocess's result dict, or None."""
+    import subprocess
+    sys.stderr.write('bench: retrying device legs in a fresh '
+                     'subprocess\n')
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             '--device-legs', datafile, str(large_n)],
+            capture_output=True,
+            timeout=int(os.environ.get('DN_BENCH_DEVICE_RETRY_TIMEOUT',
+                                       '3600')))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write('bench: device-leg subprocess timed out\n')
+        return None
+    if out.returncode != 0:
+        sys.stderr.write('bench: device-leg subprocess failed: %s\n'
+                         % out.stderr.decode()[-300:])
+        return None
+    sys.stderr.write(out.stderr.decode())
+    try:
+        res = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None
+    if not res.get('ok'):
+        sys.stderr.write('bench: device backend still unresponsive in '
+                         'subprocess; recording nulls\n')
+        return None
+    return res
+
+
+def main_parse():
+    """Parse-lane legs only (`make bench-parse` / --parse-only):
+    host-record vs native vs vector vs device parse MB/s plus
+    end-to-end `dn scan` rec/s per lane on the dense corpus."""
+    import shutil
+    import tempfile
+    nrecords = int(os.environ.get('DN_BENCH_PARSE_RECORDS', '2000000'))
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_parse_')
+    datafile = os.path.join(tmpdir, 'parse.log')
+    try:
+        gen_to_file(nrecords, datafile)
+        use_device = device_alive()
+        pb = parse_bench_extras(datafile, nrecords, use_device,
+                                end_to_end=True)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    def fmt(v):
+        return ('%.1f' % v) if v is not None else 'n/a'
+    sys.stderr.write(
+        'bench-parse: host %s MB/s, native %s, vector %s, device %s; '
+        'end-to-end host %s rec/s vector %s device %s; '
+        'vector fallback %.3f%%\n'
+        % (fmt(pb['parse_host_mb_per_sec']),
+           fmt(pb['parse_native_mb_per_sec']),
+           fmt(pb['parse_vector_mb_per_sec']),
+           fmt(pb['parse_device_mb_per_sec']),
+           pb.get('parse_host_records_per_sec', 'n/a'),
+           pb.get('parse_vector_records_per_sec', 'n/a'),
+           pb.get('parse_device_records_per_sec', 'n/a'),
+           pb['parse_vector_fallback_pct']))
+    host = pb['parse_host_mb_per_sec']
+    vec = pb['parse_vector_mb_per_sec']
+    print(json.dumps({
+        'metric': 'parse_vector_mb_per_sec',
+        'value': vec,
+        'unit': 'MB/s',
+        'vs_baseline': round(vec / host, 3) if host else None,
+        'extra': pb,
+    }))
+
+
 def main_iq():
     """Index-query legs only (`make bench-iq` / --iq-only): the serving
     path's artifact without the scan/build/device legs."""
@@ -680,12 +924,18 @@ def main_build():
 
 
 def main():
+    if '--device-legs' in sys.argv[1:]:
+        i = sys.argv.index('--device-legs')
+        return main_device_legs(sys.argv[i + 1], int(sys.argv[i + 2]))
     if '--iq-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'iq':
         return main_iq()
     if '--build-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'build':
         return main_build()
+    if '--parse-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'parse':
+        return main_parse()
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)
@@ -721,6 +971,15 @@ def main():
         runs, 'scan_300k', datafile, nrecords, QUERY, None)
 
     use_device = device_alive()
+    # wedge RECOVERY, not just detection: a probe timeout re-execs the
+    # device legs in a fresh subprocess (fresh plugin init) and
+    # retries once before nulls reach the artifact
+    device_sub = None
+    device_retries = 0
+    if not use_device and \
+            os.environ.get('DN_BENCH_DEVICE_RETRY', '1') != '0':
+        device_retries = 1
+        device_sub = device_retry_subprocess(largefile, large_n)
 
     # the large trio — auto is the headline (it must beat the best
     # single engine or the router is costing throughput)
@@ -730,6 +989,10 @@ def main():
         device_large, np_dev, dev_batches = timed_scan(
             runs, 'scan_large_device', largefile, large_n, QUERY,
             'jax')
+    elif device_sub is not None:
+        device_large = device_sub['device_large_records_per_sec']
+        np_dev = device_sub['device_output_points']
+        dev_batches = device_sub['device_batches']
     else:
         device_large, np_dev, dev_batches = None, np_host, 0
     auto_large, np_auto, _ = timed_scan(
@@ -747,6 +1010,11 @@ def main():
             runs, 'highcard_device', largefile, large_n, HC_QUERY,
             'jax', repeats=2)
         assert hc_tuples == hc_tuples_d, 'highcard outputs diverge'
+    elif device_sub is not None:
+        hc_dev = device_sub['highcard_device_records_per_sec']
+        hc_batches = device_sub['highcard_device_batches']
+        assert hc_tuples == device_sub['highcard_output_tuples'], \
+            'highcard outputs diverge (subprocess)'
     else:
         hc_dev, hc_batches = None, 0
 
@@ -758,11 +1026,20 @@ def main():
     if use_device:
         build_dev, build_stacked = timed_build(
             runs, 'build_device', largefile, large_n, 'jax')
+    elif device_sub is not None:
+        build_dev = device_sub['build_device_records_per_sec']
+        build_stacked = device_sub['build_device_stacked_batches']
     else:
         build_dev, build_stacked = None, 0
 
     iq = index_query_bench(tmpdir)
-    kb = kernel_bench_extras(largefile) if use_device else {}
+    pb = parse_bench_extras(largefile, large_n, use_device)
+    if use_device:
+        kb = kernel_bench_extras(largefile)
+    elif device_sub is not None:
+        kb = device_sub.get('kernel_extras', {})
+    else:
+        kb = {}
 
     scale = {}
     if os.environ.get('DN_BENCH_SCALE') == '1':
@@ -812,9 +1089,14 @@ def main():
         'build_device_records_per_sec':
             round(build_dev) if build_dev is not None else None,
         'build_device_stacked_batches': build_stacked,
+        'device_probe_recovered': device_sub is not None,
+        'device_probe_retries': device_retries,
         'runs': runs.summary(),
     }
+    if device_sub is not None:
+        extra['device_subprocess_runs'] = device_sub.get('runs')
     extra.update(iq)
+    extra.update(pb)
     extra.update(kb)
     extra.update(scale)
 

@@ -97,6 +97,11 @@ DN_OPTIONS = [
     # DN_IQ_STACK for one run: auto|0|1.
     (['iq-stack'], 'string', None),
     (['index-path'], 'string', None),
+    # ingest parse-lane override (not in USAGE_TEXT: the usage output
+    # is byte-pinned to the reference goldens; documented in
+    # docs/performance.md).  Equivalent to DN_PARSE for one run:
+    # auto|host|vector|device.
+    (['parse'], 'string', None),
     (['path'], 'string', None),
     (['points'], 'bool', None),
     (['raw'], 'bool', None),
@@ -473,6 +478,16 @@ def dn_output(query, opts, result, dsname):
         sys.stderr.write('would scan files:\n')
         for path in result.dry_run_files:
             sys.stderr.write('    %s\n' % path)
+        # parse-lane plan line: shown when the operator asked about it
+        # (an explicit DN_PARSE / --parse, or the full-counters view) —
+        # the default dry-run output stays byte-pinned to the
+        # reference goldens
+        import os
+        pp = getattr(result, 'parse_plan', None)
+        if pp is not None and (os.environ.get('DN_COUNTERS_ALL') == '1'
+                               or pp.get('parse_mode') != 'auto'):
+            sys.stderr.write('parse lane: %s (%s)\n'
+                             % (pp['parse_lane'], pp['reason']))
         return
 
     points = result.points or []
@@ -553,7 +568,8 @@ def _warn_printer(stage, kind, error):
 def cmd_scan(ctx, argv):
     opts = dn_parse_args(argv, ['before', 'after', 'filter', 'breakdowns',
                                 'raw', 'points', 'counters', 'warnings',
-                                'gnuplot', 'assetroot', 'dry-run'])
+                                'gnuplot', 'assetroot', 'dry-run',
+                                'parse'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     ds = datasource_for_name(ctx['config'], dsname)
@@ -561,11 +577,13 @@ def cmd_scan(ctx, argv):
         fatal(ds)
     query = dn_query_config(opts)
     warn_func = _warn_printer if getattr(opts, 'warnings', None) else None
-    try:
-        result = ds.scan(query, dry_run=opts.dry_run,
-                         warn_func=warn_func)
-    except DNError as e:
-        fatal(e)
+    with _mode_flag_env('parse', opts.parse, 'DN_PARSE',
+                        ('auto', 'host', 'vector', 'device')):
+        try:
+            result = ds.scan(query, dry_run=opts.dry_run,
+                             warn_func=warn_func)
+        except DNError as e:
+            fatal(e)
     dn_output(query, opts, result, dsname)
 
 
@@ -606,7 +624,7 @@ def _read_index_config(filename):
 def cmd_build(ctx, argv):
     opts = dn_parse_args(argv, ['after', 'before', 'counters', 'dry-run',
                                 'index-config', 'interval', 'warnings',
-                                'assetroot', 'build-threads'])
+                                'assetroot', 'build-threads', 'parse'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     indexcfg = _read_index_config(opts.index_config) \
@@ -628,7 +646,9 @@ def cmd_build(ctx, argv):
 
     warn_func = _warn_printer if getattr(opts, 'warnings', None) else None
     with _pool_flag_env('build-threads', opts.build_threads,
-                        'DN_BUILD_THREADS'):
+                        'DN_BUILD_THREADS'), \
+            _mode_flag_env('parse', opts.parse, 'DN_PARSE',
+                           ('auto', 'host', 'vector', 'device')):
         try:
             result = ds.build(metrics, opts.interval,
                               time_after=opts.after,

@@ -43,6 +43,7 @@ class ScanResult(object):
         self.points = points
         self.dry_run_files = dry_run_files
         self.dry_run_plan = None    # cluster backend: execution plan
+        self.parse_plan = None      # scan dry run: DN_PARSE lane info
         self.query = query
 
 
@@ -116,9 +117,20 @@ class DatasourceFile(object):
             raise ctx
         files, fmt = ctx
 
+        from . import byteparse as mod_byteparse
+
         if dry_run:
-            return ScanResult(pipeline,
-                              dry_run_files=[p for p, st in files])
+            result = ScanResult(pipeline,
+                                dry_run_files=[p for p, st in files])
+            from . import native as mod_native
+            lane = mod_byteparse.choose_lane(
+                [query], self.ds_timefield, self.ds_filter, fmt,
+                mod_native.get_lib() is not None)
+            result.parse_plan = {'parse_lane': lane.lane,
+                                 'parse_mode':
+                                     mod_byteparse.parse_mode(),
+                                 'reason': lane.reason}
+            return result
 
         LOG.debug('scan start', datapath=self.ds_datapath,
                   nfiles=len(files),
@@ -127,21 +139,30 @@ class DatasourceFile(object):
 
         # The vectorized engine produces identical results; --warnings
         # needs the per-record host path for ordered warning output.
-        # Within the vectorized path, ingest prefers the native C++
-        # parser (projection + dictionary encoding in one pass) and
-        # falls back to the Python record path.
+        # Within the vectorized path, ingest runs one of the DN_PARSE
+        # lanes: the native C++ parser (host), the vectorized byte
+        # parser (vector/device — byteparse.py), or the Python record
+        # path when neither engages.
         from .engine import engine_mode
         use_vector = warn_func is None and engine_mode() != 'host'
         native_lib = None
+        lane = None
         if use_vector:
             from . import native as mod_native
             native_lib = mod_native.get_lib()
+            lane = mod_byteparse.choose_lane(
+                [query], self.ds_timefield, self.ds_filter, fmt,
+                native_lib is not None)
 
-        if use_vector and native_lib is not None:
-            scanner = self._scan_native(query, files, fmt, pipeline)
+        if use_vector and (native_lib is not None or lane.engaged):
+            scanner = self._scan_native(query, files, fmt, pipeline,
+                                        lane)
         elif use_vector:
             from .engine import BATCH_SIZE
             stages = mod_ingest.make_parser_stages(pipeline, fmt)
+            # no native library AND the byte lane could not engage:
+            # the ineligibility counter must still appear
+            mod_byteparse.note_ineligible(stages[0], lane)
             scanner = self._vector_scan_cls()(
                 query, self.ds_timefield, pipeline,
                 ds_filter=self.ds_filter)
@@ -179,15 +200,31 @@ class DatasourceFile(object):
                   engine=type(scanner).__name__)
         return ScanResult(pipeline, points=points, query=query)
 
-    def _scan_native(self, query, files, fmt, pipeline):
-        """Scan via the C++ columnar parser: one pass over the
-        concatenated bytes, projected fields only, batched into the
-        vectorized engine.  (The byte stream is the concatenation of all
-        files — a partial trailing line joins across file boundaries,
-        matching catstreams semantics.)  With DN_SCAN_THREADS > 0 the
-        engine step runs on worker threads pipelined behind the parse
-        (scan_mt), with byte-identical results."""
+    def _make_parser(self, lane, paths, hints, dicts, parser_stage):
+        """Instantiate the selected ingest parser: the byte lane
+        (byteparse.ByteParser, numpy or jax structural kernel) when it
+        engaged, the native C++ parser otherwise.  A requested-but-
+        ineligible byte lane is recorded as a hidden counter."""
+        from . import byteparse as mod_byteparse
+        if lane is not None:
+            mod_byteparse.note_ineligible(parser_stage, lane)
+            if lane.engaged:
+                return mod_byteparse.ByteParser(
+                    paths, hints, dicts,
+                    device=(lane.lane == 'device'))
         from . import native as mod_native
+        return mod_native.NativeParser(paths, hints, dicts)
+
+    def _scan_native(self, query, files, fmt, pipeline, lane=None):
+        """Scan via a columnar parser — the C++ one (host lane) or the
+        vectorized byte parser (DN_PARSE=vector|device): one pass over
+        the concatenated bytes, projected fields only, batched into
+        the vectorized engine.  (The byte stream is the concatenation
+        of all files — a partial trailing line joins across file
+        boundaries, matching catstreams semantics.)  With
+        DN_SCAN_THREADS > 0 the engine step runs on worker threads
+        pipelined behind the parse (scan_mt), with byte-identical
+        results."""
         from .engine import BATCH_SIZE, NativeColumns, VectorScan
         from . import scan_mt
 
@@ -208,7 +245,8 @@ class DatasourceFile(object):
             paths = [p for p, h, d in proj]
             hints = [h for p, h, d in proj]
             dicts = [d for p, h, d in proj]
-        parser = mod_native.NativeParser(paths, hints, dicts)
+        parser = self._make_parser(lane, paths, hints, dicts,
+                                   parser_stage)
         remap = {p: np_ for p, np_ in
                  zip([p for p, h, d in proj], paths)} if skinner \
             else None
@@ -322,6 +360,8 @@ class DatasourceFile(object):
             parser_stage.counters['noutputs'] = nlines - nbad
             if nbad:
                 parser_stage.counters['invalid json'] = nbad
+        from . import byteparse as mod_byteparse
+        mod_byteparse.publish_counters(parser_stage, parser)
         return scanner
 
     # -- build / index-scan -----------------------------------------------
@@ -390,15 +430,26 @@ class DatasourceFile(object):
             and os.environ.get('DN_BUILD_ENGINE', 'auto') != 'host' \
             and engine_mode() != 'host'
         native_lib = None
+        lane = None
         if use_vector:
             from . import native as mod_native
+            from . import byteparse as mod_byteparse
             native_lib = mod_native.get_lib()
+            lane = mod_byteparse.choose_lane(
+                queries, self.ds_timefield, filter, fmt,
+                native_lib is not None)
 
-        if native_lib is not None:
+        if native_lib is not None or (lane is not None and
+                                      lane.engaged):
             scanners = self._index_scan_native(
-                queries, files, fmt, filter, pipeline)
+                queries, files, fmt, filter, pipeline, lane)
         else:
             stages = mod_ingest.make_parser_stages(pipeline, fmt)
+            if lane is not None:
+                # no native library AND the byte lane could not
+                # engage: the ineligibility counter must still appear
+                from . import byteparse as mod_byteparse
+                mod_byteparse.note_ineligible(stages[0], lane)
 
             # The datasource filter is applied once on the shared parse
             # stream; each metric's own filter lives in its StreamScan
@@ -453,13 +504,14 @@ class DatasourceFile(object):
                 tagged.append((fields, value))
         return ScanResult(pipeline, points=tagged)
 
-    def _index_scan_native(self, queries, files, fmt, filter, pipeline):
-        """Build fan-out over the native parser: ONE pass over raw bytes
-        feeds every metric's vectorized scan (the reference pipes one
-        parse stream into N StreamScans, lib/datasource-file.js:403-427;
-        here one columnar provider feeds N engine passes, parallelized
-        across worker threads when DN_SCAN_THREADS > 0)."""
-        from . import native as mod_native
+    def _index_scan_native(self, queries, files, fmt, filter, pipeline,
+                           lane=None):
+        """Build fan-out over a columnar parser (native C++ or the
+        DN_PARSE byte lane): ONE pass over raw bytes feeds every
+        metric's vectorized scan (the reference pipes one parse stream
+        into N StreamScans, lib/datasource-file.js:403-427; here one
+        columnar provider feeds N engine passes, parallelized across
+        worker threads when DN_SCAN_THREADS > 0)."""
         from .engine import (BATCH_SIZE, NativeColumns, VectorPredicate,
                              VectorScan)
         from . import scan_mt
@@ -518,7 +570,8 @@ class DatasourceFile(object):
             paths = [p for p, hd in items]
             hints = [hd[0] for p, hd in items]
             dicts = [hd[1] for p, hd in items]
-        parser = mod_native.NativeParser(paths, hints, dicts)
+        parser = self._make_parser(lane, paths, hints, dicts,
+                                   parser_stage)
         remap = {p: np_ for (p, hd), np_ in zip(items, paths)} \
             if skinner else None
 
@@ -700,6 +753,8 @@ class DatasourceFile(object):
             parser_stage.counters['noutputs'] = nlines - nbad
             if nbad:
                 parser_stage.counters['invalid json'] = nbad
+        from . import byteparse as mod_byteparse
+        mod_byteparse.publish_counters(parser_stage, parser)
         return scanners
 
     def _takeover_stream(self, files, parser, batch_size, progress,
@@ -765,35 +820,52 @@ class DatasourceFile(object):
         for path, st in files:
             sz = getattr(st, 'st_size', 0) if st is not None else 0
             total += sz if sz and sz > 0 else 0
-        done = 0
+        state = {'done': 0}
+
+        def counted_chunks():
+            for chunk in _read_ahead(files, readsz):
+                state['done'] += len(chunk)
+                yield chunk
+
+        if parse_at is None:
+            # byte-lane / plain parsers: complete-line buffers from
+            # the shared chunk-boundary joiner (ingest.py — the same
+            # carry discipline as iter_lines/iter_stream_lines)
+            for lbuf in mod_ingest.iter_line_buffers(counted_chunks()):
+                parser.parse(lbuf)
+                if parser.batch_size() >= batch_size:
+                    if progress is not None:
+                        progress(state['done'], total)
+                    flush()
+            if progress is not None:
+                progress(state['done'], total)
+            flush()
+            return
+
         carry = b''
-        for chunk in _read_ahead(files, readsz):
-            done += len(chunk)
+        for chunk in counted_chunks():
             nl = chunk.rfind(b'\n')
             if nl == -1:
                 carry += chunk
                 continue
-            if parse_at is None:
-                parser.parse(carry + chunk[:nl + 1])
-            else:
-                start = 0
-                if carry:
-                    first = chunk.index(b'\n', 0, nl + 1)
-                    parser.parse(carry + chunk[:first + 1])
-                    start = first + 1
-                arr = np.frombuffer(chunk, dtype=np.uint8)
-                if nl + 1 > start:
-                    parse_at(arr[start:].ctypes.data,
-                             nl + 1 - start)
+            start = 0
+            if carry:
+                first = chunk.index(b'\n', 0, nl + 1)
+                parser.parse(carry + chunk[:first + 1])
+                start = first + 1
+            arr = np.frombuffer(chunk, dtype=np.uint8)
+            if nl + 1 > start:
+                parse_at(arr[start:].ctypes.data,
+                         nl + 1 - start)
             carry = chunk[nl + 1:]
             if parser.batch_size() >= batch_size:
                 if progress is not None:
-                    progress(done, total)
+                    progress(state['done'], total)
                 flush()
         if carry:
             parser.parse(carry)
         if progress is not None:
-            progress(done, total)
+            progress(state['done'], total)
         flush()
 
     def _index_write(self, metrics, interval, tagged_points):
@@ -967,8 +1039,9 @@ class DatasourceFile(object):
 def _read_ahead(files, readsz):
     """Yield the concatenated chunk stream of `files` with a producer
     thread reading one chunk ahead (so file IO overlaps parse and
-    engine work while at most ~2 chunks are resident).  Producer
-    exceptions (unreadable file mid-stream) re-raise at the
+    engine work while at most ~2 chunks are resident).  Bytes come
+    through ingest.open_byte_source — the pluggable fetcher seam.
+    Producer exceptions (unreadable file mid-stream) re-raise at the
     consumer."""
     import queue as mod_queue
     import threading
@@ -988,13 +1061,9 @@ def _read_ahead(files, readsz):
     def produce():
         try:
             for path, st in files:
-                with open(path, 'rb') as f:
-                    while True:
-                        chunk = f.read(readsz)
-                        if not chunk:
-                            break
-                        if not put(chunk):
-                            return
+                for chunk in mod_ingest.open_byte_source(path, readsz):
+                    if not put(chunk):
+                        return
             put(None)
         except BaseException as e:     # re-raised by the consumer
             put(e)

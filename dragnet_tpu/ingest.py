@@ -36,53 +36,39 @@ def parser_for(fmt):
     return DNError('unsupported format: "%s"' % fmt)
 
 
-def iter_lines(paths, chunk_size=1 << 20):
-    """Yield decoded text lines from the concatenated contents of paths.
+def open_byte_source(path, chunk_size=1 << 20):
+    """THE pluggable fetcher seam: every ingest path obtains raw bytes
+    as a chunk iterator of this shape — local files are the only
+    built-in fetcher.  A remote-object-store backend (the reference's
+    Manta listInputs/fetch, lib/datasource-manta.js:392-433) would
+    plug in here by yielding fetched chunks for a remote path; today
+    remote ingest is an explicit, documented non-goal
+    (docs/architecture.md) and a shared filesystem is the contract."""
+    with open(path, 'rb') as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            yield chunk
+
+
+def iter_chunk_lines(chunks):
+    """THE chunk-boundary joiner: yield complete lines from an
+    iterable of byte chunks.  One implementation serves the file path
+    (iter_lines), the stream path (iter_stream_lines), and — via
+    iter_line_buffers — the raw-byte parse lanes, so the
+    join-across-chunks semantics can't drift apart.
 
     The carry between chunks is a *list* of chunk references, joined
     only when a newline finally arrives — appending chunks to a bytes
     buffer would re-copy the whole accumulated tail every read and go
     quadratic on multi-MB single-line inputs."""
     tail = []
-    for path in paths:
-        with open(path, 'rb') as f:
-            while True:
-                chunk = f.read(chunk_size)
-                if not chunk:
-                    break
-                nl = chunk.rfind(b'\n')
-                if nl == -1:
-                    tail.append(chunk)
-                    continue
-                head = chunk[:nl]
-                if tail:
-                    tail.append(head)
-                    head = b''.join(tail)
-                    tail = []
-                for line in head.split(b'\n'):
-                    yield line
-                rest = chunk[nl + 1:]
-                if rest:
-                    tail.append(rest)
-    if tail:
-        yield b''.join(tail)
-
-
-def iter_stream_lines(instream, chunk_size=1 << 20):
-    """Yield lines from an already-open (binary or text) stream in
-    bounded chunks — the stdin ingest path (`dn index-read`) must not
-    materialize the whole pipe.  Same linear-time carry discipline as
-    iter_lines; a trailing line without a newline is still yielded."""
-    tail = []
-    while True:
-        chunk = instream.read(chunk_size)
-        if not chunk:
-            break
-        if isinstance(chunk, str):
-            chunk = chunk.encode()
+    for chunk in chunks:
         nl = chunk.rfind(b'\n')
         if nl == -1:
-            tail.append(chunk)
+            if chunk:
+                tail.append(chunk)
             continue
         head = chunk[:nl]
         if tail:
@@ -96,6 +82,63 @@ def iter_stream_lines(instream, chunk_size=1 << 20):
             tail.append(rest)
     if tail:
         yield b''.join(tail)
+
+
+def iter_line_buffers(chunks):
+    """The same joiner at buffer granularity: yield byte buffers that
+    end on a line boundary (trailing newline included; a final partial
+    line flushes last, without one).  This is the ingest unit of the
+    columnar byte-parse lanes — one buffer per read chunk, complete
+    lines only, identical carry discipline to iter_chunk_lines."""
+    tail = []
+    for chunk in chunks:
+        nl = chunk.rfind(b'\n')
+        if nl == -1:
+            if chunk:
+                tail.append(chunk)
+            continue
+        head = chunk[:nl + 1]
+        if tail:
+            tail.append(head)
+            head = b''.join(tail)
+            tail = []
+        yield head
+        rest = chunk[nl + 1:]
+        if rest:
+            tail.append(rest)
+    if tail:
+        yield b''.join(tail)
+
+
+def _file_chunks(paths, chunk_size):
+    for path in paths:
+        for chunk in open_byte_source(path, chunk_size):
+            yield chunk
+
+
+def iter_lines(paths, chunk_size=1 << 20):
+    """Yield decoded text lines from the concatenated contents of
+    paths (catstreams semantics: a partial trailing line joins across
+    file boundaries)."""
+    return iter_chunk_lines(_file_chunks(paths, chunk_size))
+
+
+def _stream_chunks(instream, chunk_size):
+    while True:
+        chunk = instream.read(chunk_size)
+        if not chunk:
+            break
+        if isinstance(chunk, str):
+            chunk = chunk.encode()
+        yield chunk
+
+
+def iter_stream_lines(instream, chunk_size=1 << 20):
+    """Yield lines from an already-open (binary or text) stream in
+    bounded chunks — the stdin ingest path (`dn index-read`) must not
+    materialize the whole pipe.  A trailing line without a newline is
+    still yielded."""
+    return iter_chunk_lines(_stream_chunks(instream, chunk_size))
 
 
 def make_parser_stages(pipeline, fmt):

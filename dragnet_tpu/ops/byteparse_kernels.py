@@ -1,0 +1,152 @@
+"""Structural byte-stream kernel for the projected-field parser.
+
+The only *sequential* dependency in parsing escape-free newline-JSON is
+the in-string test: a byte is inside a string iff the number of quote
+bytes before it is odd.  Everything else dragnet_tpu/byteparse.py does
+— byte classes, token extraction, bracket depth (a prefix sum over the
+~6x smaller token stream), grammar checks, typed decodes — is
+elementwise or token-level work.  So the kernel contract is exactly
+that scan: ``string_parity(arr) -> uint8[n]`` giving each byte's
+*exclusive* quote parity (0 = an even number of quotes precede it).
+
+Two implementations, bit-identical (differential-tested):
+
+* ``parity_numpy`` — numpy's cumsum is a scalar loop (~130 MB/s on
+  this rig), so the scan runs bit-packed: pack the quote indicator
+  (8 bytes -> 1), take per-packed-byte parity and within-byte prefix
+  patterns from 256-entry tables, scan the 8x-smaller byte-parity
+  array, and recombine — the measured win is ~6-10x over the direct
+  cumsum, and every other pass the parser makes is SIMD-fast.
+* ``parity_jax`` — the same parity as one jnp.cumsum staged through
+  jit (XLA's scan primitive; MXU-adjacent accelerators run this at
+  memory bandwidth), selected by ``DN_PARSE=device``: raw bytes go up
+  the fast H2D direction and only the packed n/8 parity mask comes
+  back down the slow D2H one.
+
+The first device call runs under the wedge-armor deadline
+(``DN_DEVICE_PROBE_TIMEOUT``, device_scan.run_with_deadline): a hung
+device plugin costs one bounded probe and the parser degrades to the
+numpy kernel with a warning, never a hung ``dn scan``.
+"""
+
+import sys
+
+import numpy as np
+
+
+def _build_parity_tables():
+    """POPPAR[b]: parity of b's bits.  PREFIX[b]: byte whose bit j
+    (MSB-first, matching np.packbits) is the parity of b's bits before
+    j."""
+    poppar = np.zeros(256, dtype=np.uint8)
+    prefix = np.zeros(256, dtype=np.uint8)
+    for b in range(256):
+        p = 0
+        pat = 0
+        for bit in range(8):
+            if p:
+                pat |= 1 << (7 - bit)
+            if b & (1 << (7 - bit)):
+                p ^= 1
+        poppar[b] = p
+        prefix[b] = pat
+    return poppar, prefix
+
+
+_POPPAR, _PREFIX = _build_parity_tables()
+
+
+def parity_numpy(arr):
+    """uint8[n] exclusive quote parity over a byte array."""
+    n = arr.size
+    is_q = arr == ord('"')
+    packed = np.packbits(is_q)
+    bytepar = _POPPAR[packed]
+    into = ((np.cumsum(bytepar, dtype=np.int32) - bytepar) & 1) \
+        .astype(np.uint8)
+    pattern = _PREFIX[packed]
+    out_packed = pattern ^ (into * np.uint8(0xFF))
+    return np.unpackbits(out_packed)[:n]
+
+
+# -- jax variant -------------------------------------------------------------
+
+_JIT_CACHE = {}
+_DEVICE_STATE = {'ok': None}    # None = unprobed, True/False after
+
+# pad buffers to the next multiple of this so a whole scan compiles a
+# handful of program shapes, not one per chunk length
+PAD_QUANTUM = 1 << 20
+
+_BITW = (2 ** np.arange(7, -1, -1)).astype(np.uint8)   # MSB-first
+
+
+def _jax_fn():
+    from . import get_jax
+    j = get_jax()
+    if j is None:
+        return None
+    fn = _JIT_CACHE.get('fn')
+    if fn is None:
+        jax, jnp = j
+        bitw = jnp.asarray(_BITW)
+
+        def parity(arr):
+            is_q = (arr == ord('"')).astype(jnp.int32)
+            par = ((jnp.cumsum(is_q) - is_q) & 1).astype(jnp.uint8)
+            # pack 8 parity bits per byte (MSB-first, np.packbits
+            # layout) so the D2H fetch moves n/8 bytes, not n
+            return (par.reshape(-1, 8) * bitw).sum(
+                axis=1).astype(jnp.uint8)
+
+        fn = jax.jit(parity)
+        _JIT_CACHE['fn'] = fn
+    return fn
+
+
+def _parity_jax_call(arr):
+    fn = _jax_fn()
+    n = arr.shape[0]
+    padded_n = -(-max(n, 1) // PAD_QUANTUM) * PAD_QUANTUM
+    if padded_n != n:
+        # pad bytes are zeros: no quotes, parity over the real span is
+        # unaffected
+        buf = np.zeros(padded_n, dtype=np.uint8)
+        buf[:n] = arr
+    else:
+        buf = arr
+    packed = np.asarray(fn(buf))
+    return np.unpackbits(packed)[:n]
+
+
+def device_parity_available():
+    """Whether the jax parity kernel is usable (without probing a
+    possibly-hung backend more than once)."""
+    from . import get_jax
+    if get_jax() is None:
+        return False
+    return _DEVICE_STATE['ok'] is not False
+
+
+def parity_device(arr):
+    """The jax parity scan with first-contact wedge armor: the first
+    call runs under DN_DEVICE_PROBE_TIMEOUT on a daemon thread; a
+    timeout or error warns once and pins the numpy kernel for the rest
+    of the process (identical arrays either way)."""
+    if _DEVICE_STATE['ok'] is True:
+        return _parity_jax_call(arr)
+    if _DEVICE_STATE['ok'] is False:
+        return parity_numpy(arr)
+    from ..device_scan import probe_deadline_s, run_with_deadline
+    status, result = run_with_deadline(
+        lambda: _parity_jax_call(arr), probe_deadline_s(),
+        'byteparse-parity')
+    if status == 'ok':
+        _DEVICE_STATE['ok'] = True
+        return result
+    _DEVICE_STATE['ok'] = False
+    sys.stderr.write(
+        'dn: warning: device parse kernel %s; using host vector '
+        'kernel\n' % ('probe timed out' if status == 'timeout'
+                      else 'failed (%s)' % (result,)))
+    return parity_numpy(arr)

@@ -241,6 +241,7 @@ class DatasourceCluster(datasource_file.DatasourceFile):
         process topology, this process's input partition, and the local
         device mesh the sharded program would run over."""
         nprocs, pid = mod_dist.maybe_initialize()
+        from ..byteparse import parse_mode
         from ..index_build_mt import build_threads
         from ..index_query_mt import iq_threads
         from ..index_query_stack import stack_mode
@@ -267,6 +268,11 @@ class DatasourceCluster(datasource_file.DatasourceFile):
             # processes in the reduce phase
             'index_query_stack': stack_mode(),
             'index_build_threads': build_threads(),
+            # raw-byte ingest lane (byteparse): auto routes eligible
+            # flat-projection json scans through the vectorized byte
+            # parser when the native toolchain is absent; vector/device
+            # force it (device = structural scan staged through jax)
+            'parse_mode': parse_mode(),
         }
         # informational only — must never pay backend initialization
         # (over a tunneled device plugin the first probe can block for

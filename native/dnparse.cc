@@ -226,6 +226,18 @@ inline bool two_digits(const char* p, int* out) {
 
 bool parse_iso_date(const char* s, size_t len, int64_t* ms_out) {
   // YYYY[-MM[-DD]][T HH:MM[:SS[.fff...]][Z|+-HH:MM|+-HHMM]]
+  // The Python reference (jsvalues.date_parse) strips surrounding
+  // whitespace before matching; mirror it so both parse lanes agree.
+  while (len > 0 && (*s == ' ' || *s == '\t' || *s == '\r' ||
+                     *s == '\n' || *s == '\f' || *s == '\v')) {
+    s++;
+    len--;
+  }
+  while (len > 0 && (s[len - 1] == ' ' || s[len - 1] == '\t' ||
+                     s[len - 1] == '\r' || s[len - 1] == '\n' ||
+                     s[len - 1] == '\f' || s[len - 1] == '\v')) {
+    len--;
+  }
   if (len < 4) return false;
   const char* p = s;
   const char* end = s + len;
@@ -290,6 +302,8 @@ bool parse_iso_date(const char* s, size_t len, int64_t* ms_out) {
     }
   }
   if (p != end) return false;
+  // the Python reference path builds a datetime, which rejects year 0
+  if (year < 1) return false;
   if (month < 1 || month > 12) return false;
   static const int kDays[] = {31, 28, 31, 30, 31, 30,
                               31, 31, 30, 31, 30, 31};

@@ -37,3 +37,90 @@ def test_device_alive_true_on_working_backend():
     # the suite runs on the CPU backend (conftest): a real, working
     # device_put round trip
     assert bench.device_alive(timeout_s=180) is True
+
+
+# -- wedge recovery: the subprocess re-exec retry --------------------------
+
+def test_device_retry_parses_subprocess_result(monkeypatch):
+    """A healthy re-exec'd subprocess recovers the device legs."""
+    import json
+    import subprocess
+    payload = {'ok': True, 'device_large_records_per_sec': 123,
+               'device_output_points': 4, 'device_batches': 7}
+
+    class FakeProc(object):
+        returncode = 0
+        stdout = (json.dumps(payload) + '\n').encode()
+        stderr = b''
+    calls = []
+
+    def fake_run(cmd, **kwargs):
+        calls.append(cmd)
+        return FakeProc()
+    monkeypatch.setattr(subprocess, 'run', fake_run)
+    res = bench.device_retry_subprocess('/tmp/x.log', 1000)
+    assert res == payload
+    assert '--device-legs' in calls[0]
+
+
+def test_device_retry_null_on_still_wedged(monkeypatch):
+    """A subprocess that also finds the backend dead (ok: false), or
+    that fails outright, yields None — the caller records nulls only
+    after the retry."""
+    import subprocess
+
+    class DeadProc(object):
+        returncode = 0
+        stdout = b'{"ok": false}\n'
+        stderr = b''
+    monkeypatch.setattr(subprocess, 'run',
+                        lambda cmd, **kw: DeadProc())
+    assert bench.device_retry_subprocess('/tmp/x.log', 1000) is None
+
+    class BrokenProc(object):
+        returncode = 3
+        stdout = b''
+        stderr = b'boom'
+    monkeypatch.setattr(subprocess, 'run',
+                        lambda cmd, **kw: BrokenProc())
+    assert bench.device_retry_subprocess('/tmp/x.log', 1000) is None
+
+    def timeout_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, 1)
+    monkeypatch.setattr(subprocess, 'run', timeout_run)
+    assert bench.device_retry_subprocess('/tmp/x.log', 1000) is None
+
+
+# -- parse-lane legs: tier-1-safe smoke ------------------------------------
+
+def test_parse_bench_extras_smoke(tmp_path, monkeypatch):
+    """The parse-lane measurement runs on the CPU backend and records
+    every lane's rate plus the fallback share."""
+    datafile = str(tmp_path / 'parse.log')
+    n = 8000
+    bench.gen_to_file(n, datafile)
+    monkeypatch.setenv('DN_BENCH_PARSE_BYTES', str(1 << 20))
+    use_device = ops.get_jax() is not None
+    out = bench.parse_bench_extras(datafile, n, use_device,
+                                   end_to_end=True)
+    assert out['parse_host_mb_per_sec'] > 0
+    assert out['parse_vector_mb_per_sec'] > 0
+    assert out['parse_vector_fallback_pct'] < 1.0
+    assert out['parse_host_records_per_sec'] > 0
+    assert out['parse_vector_records_per_sec'] > 0
+    if use_device:
+        assert out['parse_device_mb_per_sec'] > 0
+        assert out['parse_device_records_per_sec'] > 0
+
+
+@pytest.mark.slow
+def test_main_parse_emits_json_line(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv('DN_BENCH_PARSE_RECORDS', '20000')
+    monkeypatch.setenv('DN_BENCH_PARSE_BYTES', str(2 << 20))
+    bench.main_parse()
+    import json
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    doc = json.loads(line)
+    assert doc['metric'] == 'parse_vector_mb_per_sec'
+    assert doc['value'] > 0
+    assert 'parse_host_mb_per_sec' in doc['extra']
