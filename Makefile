@@ -5,7 +5,7 @@
 PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
-    clean parity-matrix
+    bench-serve clean parity-matrix
 
 all: native
 
@@ -41,6 +41,12 @@ bench-build: native
 # ingest MB/s + end-to-end scan rec/s per DN_PARSE lane (byteparse)
 bench-parse: native
 	$(PYTHON) bench.py --parse-only
+
+# the serving legs only: cold-CLI-process vs warm `dn serve` daemon
+# index-query p50/p95, end-to-end rec/s through the socket, request
+# coalescing, and /stats (device engagement, cache hit rates)
+bench-serve: native
+	$(PYTHON) bench.py --serve-only
 
 # golden byte-parity under every engine (the strongest single seal:
 # host per-record, vectorized, forced device, auto router), then the

@@ -814,6 +814,212 @@ def device_retry_subprocess(datafile, large_n):
     return res
 
 
+def serve_bench(tmpdir):
+    """The `dn serve` legs (--serve-only / make bench-serve): the same
+    index-query workload as bench-iq, but measured the way the serving
+    tier actually pays for it — a COLD CLI process per query (the
+    pre-serve reality: interpreter boot + import + open/parse per
+    invocation) vs a warm resident server answering over the unix
+    socket with its shard-handle/find-memo caches and compiled
+    programs hot.  Also records end-to-end scan rec/s through the
+    server, a coalescing burst, and the /stats document's
+    device_path_engaged + cache hit rates in the artifact extras."""
+    import shutil
+    import signal
+    import subprocess
+    from dragnet_tpu import config as mod_config
+    from dragnet_tpu.serve import client as mod_scl
+    from dragnet_tpu.serve import lifecycle as mod_lc
+
+    n = int(os.environ.get('DN_BENCH_SERVE_RECORDS', '200000'))
+    days = int(os.environ.get('DN_BENCH_SERVE_DAYS', '120'))
+    cold_reps = int(os.environ.get('DN_BENCH_SERVE_COLD_REPS', '5'))
+    warm_reps = int(os.environ.get('DN_BENCH_SERVE_WARM_REPS', '25'))
+
+    datafile = os.path.join(tmpdir, 'serve.log')
+    idx = os.path.join(tmpdir, 'serve.idx')
+    rc_path = os.path.join(tmpdir, 'serve_rc.json')
+    sock = os.path.join(tmpdir, 'dn.sock')
+    start_ms = 1388534400000             # 2014-01-01
+    gen_to_file(n, datafile, mindate_ms=start_ms,
+                maxdate_ms=start_ms + days * 86400000)
+
+    # a dragnet config the CLI (cold subprocess) and the server share
+    cfg = mod_config.create_initial_config()
+    cfg = cfg.datasource_add({
+        'name': 'servebench', 'backend': 'file',
+        'backend_config': {'path': datafile, 'indexPath': idx,
+                           'timeField': 'time'},
+        'filter': None, 'dataFormat': 'json'})
+    for m in METRICS:
+        cfg = cfg.metric_add({'name': m['name'],
+                              'datasource': 'servebench',
+                              'filter': m.get('filter'),
+                              'breakdowns': m['breakdowns']})
+    mod_config.ConfigBackendLocal(rc_path).save(cfg.serialize())
+
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    ds = make_ds(datafile, idx)
+    ds.build(metrics, 'day')
+    nshards = 0
+    for root, dirs, files in os.walk(idx):
+        nshards += len(files)
+
+    env = dict(os.environ, DRAGNET_CONFIG=rc_path)
+    dn = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      'bin', 'dn.py')
+    query_args = ['query', '-b', 'host,latency[aggr=quantize]', '-f',
+                  '{"eq": ["req.method", "GET"]}', 'servebench']
+
+    def pctl(times):
+        times = sorted(times)
+        return (times[len(times) // 2],
+                times[min(len(times) - 1, int(len(times) * 0.95))])
+
+    # cold: one full CLI process per query (the pre-serve shape)
+    cold_times = []
+    cold_out = None
+    for _ in range(cold_reps):
+        t0 = time.monotonic()
+        p = subprocess.run([sys.executable, dn] + query_args,
+                           capture_output=True, env=env, timeout=300)
+        cold_times.append((time.monotonic() - t0) * 1000)
+        if p.returncode != 0:
+            raise RuntimeError('cold CLI query failed: %s'
+                               % p.stderr.decode()[-300:])
+        cold_out = p.stdout
+    cold_p50, cold_p95 = pctl(cold_times)
+
+    # the warm resident server
+    proc = subprocess.Popen([sys.executable, dn, 'serve', '--socket',
+                             sock], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 60
+        while not mod_lc.probe(socket_path=sock):
+            if time.monotonic() > deadline or proc.poll() is not None:
+                raise RuntimeError('serve daemon failed to start')
+            time.sleep(0.1)
+
+        req = {'op': 'query', 'ds': 'servebench', 'interval': 'day',
+               'config': rc_path,
+               'queryconfig': {
+                   'breakdowns': [
+                       {'name': 'host', 'field': 'host'},
+                       {'name': 'latency', 'field': 'latency',
+                        'aggr': 'quantize'}],
+                   'filter': {'eq': ['req.method', 'GET']}},
+               'opts': {}}
+        rc0, _, warm_out, _ = mod_scl.request_bytes(sock, req)
+        assert rc0 == 0
+        warm_times = []
+        for _ in range(warm_reps):
+            t0 = time.monotonic()
+            rc0, _, out_b, _ = mod_scl.request_bytes(sock, req)
+            warm_times.append((time.monotonic() - t0) * 1000)
+            assert rc0 == 0
+            warm_out = out_b
+        warm_p50, warm_p95 = pctl(warm_times)
+        output_match = warm_out == cold_out
+
+        # end-to-end scan rec/s through the warm server
+        scan_req = {'op': 'scan', 'ds': 'servebench',
+                    'config': rc_path,
+                    'queryconfig': {'breakdowns': [
+                        {'name': 'host', 'field': 'host'},
+                        {'name': 'operation', 'field': 'operation'}]},
+                    'opts': {}}
+        mod_scl.request_bytes(sock, scan_req, timeout_s=600)
+        t0 = time.monotonic()
+        rc0, _, _, _ = mod_scl.request_bytes(sock, scan_req,
+                                             timeout_s=600)
+        scan_rps = n / (time.monotonic() - t0) if rc0 == 0 else None
+
+        # coalescing burst: concurrent identical queries share one
+        # stacked execution (serve-side payoff of index_query_stack)
+        import threading
+        burst = int(os.environ.get('DN_BENCH_SERVE_BURST', '8'))
+        barrier = threading.Barrier(burst)
+
+        def fire():
+            barrier.wait()
+            mod_scl.request_bytes(sock, req)
+        threads = [threading.Thread(target=fire)
+                   for _ in range(burst)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        st = mod_scl.stats(sock)
+        proc.send_signal(signal.SIGTERM)
+        drained = proc.wait(timeout=60) == 0 and \
+            not os.path.exists(sock)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        shutil.rmtree(idx, ignore_errors=True)
+        os.unlink(datafile)
+
+    reqs = st['requests']
+    caches = st['caches']['shard_handles']
+    return {
+        'serve_records': n,
+        'serve_shards': nshards,
+        'serve_query_cold_cli_p50_ms': round(cold_p50, 2),
+        'serve_query_cold_cli_p95_ms': round(cold_p95, 2),
+        'serve_query_warm_p50_ms': round(warm_p50, 2),
+        'serve_query_warm_p95_ms': round(warm_p95, 2),
+        'serve_warm_vs_cold': round(cold_p50 / warm_p50, 2)
+        if warm_p50 else None,
+        'serve_scan_records_per_sec': round(scan_rps)
+        if scan_rps else None,
+        'serve_output_byte_identical': output_match,
+        'serve_requests': reqs['requests'],
+        'serve_executions': reqs['executions'],
+        'serve_coalesced_requests': reqs['coalesced'],
+        'serve_cache_hits': caches['hits'],
+        'serve_cache_misses': caches['misses'],
+        'device_path_engaged': st['device']['engaged'],
+        'serve_drained_clean': bool(drained),
+    }
+
+
+def main_serve():
+    """Serve legs only (`make bench-serve` / --serve-only)."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_serve_')
+    try:
+        sv = serve_bench(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    sys.stderr.write(
+        'bench-serve: %d shards; warm p50 %.1fms p95 %.1fms vs cold '
+        'CLI p50 %.1fms (%.1fx); scan %s rec/s; coalesced %d/%d '
+        'requests; cache %d hits / %d misses; device engaged %s; '
+        'output identical %s; drained %s\n'
+        % (sv['serve_shards'], sv['serve_query_warm_p50_ms'],
+           sv['serve_query_warm_p95_ms'],
+           sv['serve_query_cold_cli_p50_ms'],
+           sv['serve_warm_vs_cold'] or 0.0,
+           sv['serve_scan_records_per_sec'],
+           sv['serve_coalesced_requests'], sv['serve_requests'],
+           sv['serve_cache_hits'], sv['serve_cache_misses'],
+           sv['device_path_engaged'],
+           sv['serve_output_byte_identical'],
+           sv['serve_drained_clean']))
+    print(json.dumps({
+        'metric': 'serve_query_warm_p50_ms',
+        'value': sv['serve_query_warm_p50_ms'],
+        'unit': 'ms',
+        'vs_baseline': sv['serve_warm_vs_cold'],
+        'extra': sv,
+    }))
+
+
 def main_parse():
     """Parse-lane legs only (`make bench-parse` / --parse-only):
     host-record vs native vs vector vs device parse MB/s plus
@@ -936,6 +1142,9 @@ def main():
     if '--parse-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'parse':
         return main_parse()
+    if '--serve-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'serve':
+        return main_serve()
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)

@@ -103,10 +103,20 @@ DN_OPTIONS = [
     # auto|host|vector|device.
     (['parse'], 'string', None),
     (['path'], 'string', None),
+    # `dn serve` endpoint options (pidfile/port/socket/validate) and
+    # the data commands' --remote endpoint (unix socket path or
+    # HOST:PORT; unreachable servers warn and fall back to local
+    # execution).  None appear in USAGE_TEXT — the usage output is
+    # byte-pinned to the reference goldens; see docs/serving.md.
+    (['pidfile'], 'string', None),
     (['points'], 'bool', None),
+    (['port'], 'string', None),
     (['raw'], 'bool', None),
+    (['remote'], 'string', None),
+    (['socket'], 'string', None),
     (['time-field'], 'string', None),
     (['time-format'], 'string', None),
+    (['validate'], 'bool', None),
     (['verbose', 'v'], 'bool', False),
     (['warnings'], 'bool', None),
 ]
@@ -429,7 +439,10 @@ def cmd_metric_list(ctx, argv):
 # Data commands
 # ---------------------------------------------------------------------------
 
-def dn_query_config(opts):
+def dn_query_doc(opts):
+    """The query document parsed options produce — query_load's input
+    here, and the document `--remote` ships so the server's
+    query_load yields the identical QueryConfig."""
     queryconfig = {'breakdowns': opts.breakdowns}
     if opts.after:
         queryconfig['timeAfter'] = opts.after
@@ -437,8 +450,11 @@ def dn_query_config(opts):
         queryconfig['timeBefore'] = opts.before
     if opts.filter is not None:
         queryconfig['filter'] = opts.filter
+    return queryconfig
 
-    qc = mod_query.query_load(queryconfig)
+
+def dn_query_config(opts):
+    qc = mod_query.query_load(dn_query_doc(opts))
     if isinstance(qc, DNError):
         fatal(qc)
 
@@ -565,17 +581,70 @@ def _warn_printer(stage, kind, error):
     sys.stderr.write('    at %s\n' % stage.name)
 
 
+# ---------------------------------------------------------------------------
+# Remote execution (`--remote SOCK` -> a resident `dn serve`)
+# ---------------------------------------------------------------------------
+
+def _remote_output_opts(opts):
+    return {
+        'raw': bool(getattr(opts, 'raw', None)),
+        'points': bool(getattr(opts, 'points', None)),
+        'counters': bool(getattr(opts, 'counters', None)),
+        'gnuplot': bool(getattr(opts, 'gnuplot', None)),
+        'dry_run': bool(getattr(opts, 'dry_run', None)),
+    }
+
+
+# per-run execution-mode flags that scope a process-local env var for
+# one command: they cannot travel to a shared server (whose process
+# env governs every request), and silently dropping them would be a
+# behavior change the user explicitly asked against
+_LOCAL_ONLY_FLAGS = [('warnings', '--warnings'), ('parse', '--parse'),
+                     ('iq_threads', '--iq-threads'),
+                     ('iq_stack', '--iq-stack'),
+                     ('build_threads', '--build-threads')]
+
+
+def _try_remote(ctx, opts, req):
+    """Ship `req` to opts.remote.  Returns the remote exit code, or
+    None after the unreachable-fallback warning (the caller then runs
+    the command locally).  Local-only flags must not silently go
+    remote: --warnings needs the local per-record path, and the
+    execution-mode flags above only scope this process's env."""
+    for attr, flag in _LOCAL_ONLY_FLAGS:
+        if getattr(opts, attr, None):
+            raise UsageError(
+                '"%s" cannot be combined with "--remote"' % flag)
+    req['config'] = ctx['backend'].cbl_path
+    from .serve import client as mod_serve_client
+    try:
+        return mod_serve_client.run_or_fallback(opts.remote, req)
+    except DNError as e:
+        # post-commit transport failure (RemoteTransportError): the
+        # server already acted and bytes may already be on stdout, so
+        # neither retrying nor falling back locally is safe — report
+        fatal(e)
+
+
 def cmd_scan(ctx, argv):
     opts = dn_parse_args(argv, ['before', 'after', 'filter', 'breakdowns',
                                 'raw', 'points', 'counters', 'warnings',
                                 'gnuplot', 'assetroot', 'dry-run',
-                                'parse'])
+                                'parse', 'remote'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     ds = datasource_for_name(ctx['config'], dsname)
     if isinstance(ds, DNError):
         fatal(ds)
     query = dn_query_config(opts)
+    if opts.remote:
+        rc = _try_remote(ctx, opts, {
+            'op': 'scan', 'ds': dsname,
+            'queryconfig': dn_query_doc(opts),
+            'opts': _remote_output_opts(opts),
+        })
+        if rc is not None:
+            return rc
     warn_func = _warn_printer if getattr(opts, 'warnings', None) else None
     with _mode_flag_env('parse', opts.parse, 'DN_PARSE',
                         ('auto', 'host', 'vector', 'device')):
@@ -591,13 +660,21 @@ def cmd_query(ctx, argv):
     opts = dn_parse_args(argv, ['before', 'after', 'filter', 'breakdowns',
                                 'raw', 'points', 'counters', 'interval',
                                 'gnuplot', 'assetroot', 'dry-run',
-                                'iq-threads', 'iq-stack'])
+                                'iq-threads', 'iq-stack', 'remote'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     ds = datasource_for_name(ctx['config'], dsname)
     if isinstance(ds, DNError):
         fatal(ds)
     query = dn_query_config(opts)
+    if opts.remote:
+        rc = _try_remote(ctx, opts, {
+            'op': 'query', 'ds': dsname, 'interval': opts.interval,
+            'queryconfig': dn_query_doc(opts),
+            'opts': _remote_output_opts(opts),
+        })
+        if rc is not None:
+            return rc
 
     with _pool_flag_env('iq-threads', opts.iq_threads, 'DN_IQ_THREADS'), \
             _mode_flag_env('iq-stack', opts.iq_stack, 'DN_IQ_STACK',
@@ -624,7 +701,8 @@ def _read_index_config(filename):
 def cmd_build(ctx, argv):
     opts = dn_parse_args(argv, ['after', 'before', 'counters', 'dry-run',
                                 'index-config', 'interval', 'warnings',
-                                'assetroot', 'build-threads', 'parse'])
+                                'assetroot', 'build-threads', 'parse',
+                                'remote'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     indexcfg = _read_index_config(opts.index_config) \
@@ -643,6 +721,16 @@ def cmd_build(ctx, argv):
                                 index_config=indexcfg)
     if len(metrics) == 0:
         fatal(DNError('no metrics defined for dataset "%s"' % dsname))
+
+    if opts.remote:
+        rc = _try_remote(ctx, opts, {
+            'op': 'build', 'ds': dsname, 'interval': opts.interval,
+            'before': opts.before, 'after': opts.after,
+            'index_config': indexcfg,
+            'opts': _remote_output_opts(opts),
+        })
+        if rc is not None:
+            return rc
 
     warn_func = _warn_printer if getattr(opts, 'warnings', None) else None
     with _pool_flag_env('build-threads', opts.build_threads,
@@ -725,6 +813,51 @@ def cmd_index_read(ctx, argv):
         fatal(e)
 
 
+def cmd_serve(ctx, argv):
+    """`dn serve --socket PATH | --port N [--pidfile P] [--validate]`:
+    the resident query server (serve/server.py).  Not in USAGE_TEXT —
+    the usage output is byte-pinned to the reference goldens;
+    documented in docs/serving.md."""
+    opts = dn_parse_args(argv, ['socket', 'port', 'pidfile',
+                                'validate'])
+    check_arg_count(opts, 0)
+
+    conf = mod_config.serve_config()
+    if isinstance(conf, DNError):
+        fatal(conf)
+
+    port = None
+    if opts.port is not None:
+        try:
+            port = int(opts.port)
+            if not 0 <= port <= 65535:
+                raise ValueError(opts.port)
+        except ValueError:
+            raise UsageError('bad value for "port": "%s"' % opts.port)
+    if (opts.socket is None) == (port is None):
+        raise UsageError(
+            'exactly one of "--socket" and "--port" is required')
+
+    if getattr(opts, 'validate', None):
+        # dry mode: the DN_SERVE_* knobs and the endpoint arguments
+        # were just validated through the same paths the daemon uses;
+        # report the resolved configuration and exit without binding
+        sys.stdout.write(
+            'serve config ok: max_inflight=%d queue_depth=%d '
+            'deadline_ms=%d coalesce=%d drain_s=%d\n'
+            % (conf['max_inflight'], conf['queue_depth'],
+               conf['deadline_ms'], 1 if conf['coalesce'] else 0,
+               conf['drain_s']))
+        return 0
+
+    from .serve import server as mod_server
+    try:
+        return mod_server.serve_main(socket_path=opts.socket,
+                                     port=port, pidfile=opts.pidfile)
+    except DNError as e:
+        fatal(e)
+
+
 COMMANDS = {
     'datasource-add': cmd_datasource_add,
     'datasource-list': cmd_datasource_list,
@@ -740,6 +873,7 @@ COMMANDS = {
     'index-scan': cmd_index_scan,
     'query': cmd_query,
     'scan': cmd_scan,
+    'serve': cmd_serve,
 }
 
 
@@ -761,6 +895,7 @@ def main(argv=None, startup=None):
     if startup is not None:
         t0, require_s = startup[0], startup[1]
 
+    rv = None
     try:
         if len(argv) < 1:
             raise UsageError('no command specified')
@@ -773,7 +908,7 @@ def main(argv=None, startup=None):
         if err is not None and not getattr(err, 'is_enoent', False):
             fatal(err)
         ctx = {'backend': backend, 'config': config}
-        COMMANDS[cmdname](ctx, argv[1:])
+        rv = COMMANDS[cmdname](ctx, argv[1:])
     except UsageError as e:
         if e.message:
             sys.stderr.write('%s: %s\n' % (ARG0, e.message))
@@ -790,4 +925,5 @@ def main(argv=None, startup=None):
         if require_s is not None:
             sys.stderr.write('    require:  %.3fs\n' % require_s)
         sys.stderr.write('    total:    %.3fs\n' % (time.time() - t0))
-    return 0
+    # remote-executing commands propagate the server's exit code
+    return rv if isinstance(rv, int) else 0

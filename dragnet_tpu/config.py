@@ -302,6 +302,60 @@ def load_config(inp):
     return dc
 
 
+# --- dn serve knobs (DN_SERVE_*) --------------------------------------
+#
+# Parsed and validated in ONE place so `dn serve` (and its --validate
+# dry mode) fails fast with the shared DNError contract instead of at
+# the first request.  Each entry: (env name, kind, default, minimum).
+
+_SERVE_KNOBS = [
+    # concurrent data-command executions; queue-full beyond this +
+    # queue_depth is a fast 429-style DNError
+    ('DN_SERVE_MAX_INFLIGHT', 'int', 4, 1),
+    # requests allowed to WAIT for an execution slot before the
+    # server starts rejecting ("429")
+    ('DN_SERVE_QUEUE_DEPTH', 'int', 16, 0),
+    # per-request wall-clock deadline; 0 disables
+    ('DN_SERVE_DEADLINE_MS', 'int', 0, 0),
+    # share one execution across identical/compatible in-flight
+    # requests (admission.py); 0 disables
+    ('DN_SERVE_COALESCE', 'bool', True, None),
+    # how long a SIGTERM/SIGINT drain waits for in-flight requests
+    ('DN_SERVE_DRAIN_S', 'int', 30, 0),
+]
+
+
+def serve_config(env=None):
+    """The resolved DN_SERVE_* knob dict (keys: max_inflight,
+    queue_depth, deadline_ms, coalesce, drain_s), or DNError on the
+    first malformed value — 'DN_SERVE_X: expected ..., got "v"'."""
+    if env is None:
+        env = os.environ
+    rv = {}
+    for name, kind, default, minimum in _SERVE_KNOBS:
+        key = name[len('DN_SERVE_'):].lower()
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        if kind == 'bool':
+            if raw not in ('0', '1'):
+                return DNError('%s: expected 0 or 1, got "%s"'
+                               % (name, raw))
+            rv[key] = raw == '1'
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        if value < minimum:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        rv[key] = value
+    return rv
+
+
 class ConfigBackendLocal(object):
     """JSON config file with atomic tmp+rename save."""
 

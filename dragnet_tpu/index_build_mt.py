@@ -53,6 +53,42 @@ _EXECUTOR_LEAKS = LeakCheck(
     'index-build flush executor(s) never drained; index shards may be '
     'missing', lambda ex: not ex.closed)
 
+# -- post-write notification ------------------------------------------------
+#
+# Every completed index write (build fan-out, streaming index-read,
+# the `_index_write` path) already invalidates the reader caches shard
+# by shard (shard_cache_invalidate); these hooks additionally tell
+# long-lived observers — `dn serve`'s lifecycle layer — that a write
+# LANDED, so they can retire whole-tree derived state (find memo,
+# handle cache sweeps) and count invalidations coherently.
+
+_WRITE_HOOKS_LOCK = threading.Lock()
+_WRITE_HOOKS = []
+
+
+def register_index_write_hook(fn):
+    """fn(indexroot, shard_paths) runs after every completed index
+    write.  Hook errors are swallowed (writers must not fail because
+    an observer did)."""
+    with _WRITE_HOOKS_LOCK:
+        _WRITE_HOOKS.append(fn)
+
+
+def unregister_index_write_hook(fn):
+    with _WRITE_HOOKS_LOCK:
+        if fn in _WRITE_HOOKS:
+            _WRITE_HOOKS.remove(fn)
+
+
+def _notify_index_written(indexroot, paths):
+    with _WRITE_HOOKS_LOCK:
+        hooks = list(_WRITE_HOOKS)
+    for fn in hooks:
+        try:
+            fn(indexroot, list(paths))
+        except Exception:
+            pass
+
 
 def build_threads():
     """Worker-pool size for the index-write fan-out.  DN_BUILD_THREADS:
@@ -255,9 +291,11 @@ def write_index_blocks(metrics, interval, indexroot, blocks,
         for mi, (names, cols, weights) in enumerate(blocks):
             sel = _breakdown_positions(names, metrics[mi])
             parts.append((mi, [cols[p] for p in sel], weights))
+        allpath = os.path.join(indexroot, 'all')
         run_flush_tasks(
-            [_bucket_task(metrics, os.path.join(indexroot, 'all'),
-                          None, parts, catalog)], nworkers)
+            [_bucket_task(metrics, allpath, None, parts, catalog)],
+            nworkers)
+        _notify_index_written(indexroot, [allpath])
         return
 
     span = interval_span(interval)
@@ -294,13 +332,16 @@ def write_index_blocks(metrics, interval, indexroot, blocks,
                  [weights[i] for i in idxs]))
 
     tasks = []
+    paths = []
     for bucket_s in sorted(buckets):
         indexpath = os.path.join(
             root, bucket_label(bucket_s, interval) + '.sqlite')
+        paths.append(indexpath)
         tasks.append(_bucket_task(metrics, indexpath,
                                   {'dn_start': bucket_s},
                                   buckets[bucket_s], catalog))
     run_flush_tasks(tasks, nworkers)
+    _notify_index_written(indexroot, paths)
 
 
 # -- streaming entry: tagged point chunks -> sharded index files -----------
@@ -321,6 +362,7 @@ class StreamingIndexWriter(object):
     def __init__(self, metrics, interval, indexroot):
         self.metrics = metrics
         self.interval = interval
+        self.indexroot = indexroot
         self._catalog = metric_catalog_rows(metrics)
         self._names = [[b['b_name'] for b in m.m_breakdowns]
                        for m in metrics]
@@ -418,3 +460,5 @@ class StreamingIndexWriter(object):
                 if not done[i]:
                     sink.abort()
             raise
+        _notify_index_written(self.indexroot,
+                              list(self.sinkpaths.values()))

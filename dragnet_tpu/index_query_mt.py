@@ -47,6 +47,8 @@ from datetime import datetime, timedelta, timezone
 
 from .errors import DNError
 from .aggr import Aggregator
+from . import vpipe
+from .vpipe import counter_bump
 from .watchdog import LeakCheck
 from . import find as mod_find
 from .index_query import open_index
@@ -304,6 +306,12 @@ def checkout_shard(path):
             if now - handle.checked_at < _stat_ttl():
                 with _CACHE_LOCK:
                     _CACHE_STATS['hits'] += 1
+                    # re-lease under the CURRENT generation: this
+                    # handle survived any sweeps since it was cached,
+                    # so only invalidations during the new lease
+                    # should retire it at checkin
+                    handle.gen = (_EPOCH[0], _INVAL_GEN.get(path, 0))
+                counter_bump('index handle cache hits')
                 handle.last_used = now
                 handle.leased = True
                 return handle
@@ -311,6 +319,8 @@ def checkout_shard(path):
             if statkey is not None and handle.statkey == statkey:
                 with _CACHE_LOCK:
                     _CACHE_STATS['hits'] += 1
+                    handle.gen = (_EPOCH[0], _INVAL_GEN.get(path, 0))
+                counter_bump('index handle cache hits')
                 handle.checked_at = now
                 handle.last_used = now
                 handle.leased = True
@@ -319,6 +329,7 @@ def checkout_shard(path):
     with _CACHE_LOCK:
         _CACHE_STATS['misses'] += 1
         gen = (_EPOCH[0], _INVAL_GEN.get(path, 0))
+    counter_bump('index handle cache misses')
     statkey = _statkey(path)
     try:
         querier = open_index(path)
@@ -406,6 +417,45 @@ def shard_cache_clear():
 def shard_cache_stats():
     with _CACHE_LOCK:
         return dict(_CACHE_STATS, size=len(_CACHE))
+
+
+def invalidate_index_tree(root):
+    """Drop every cached handle and find-memo entry at or under
+    `root` — the serving layer's post-build coherence hook: a rebuild
+    touches many shards (and may DELETE some), so after the per-path
+    writer invalidations the whole tree's cached state is retired in
+    one sweep.  Cheap when nothing under `root` is cached."""
+    root = os.path.abspath(root)
+    prefix = root + os.sep
+    closing = []
+    with _CACHE_LOCK:
+        for path in [p for p in _CACHE
+                     if os.path.abspath(p) == root or
+                     os.path.abspath(p).startswith(prefix)]:
+            _INVAL_GEN[path] = _INVAL_GEN.get(path, 0) + 1
+            closing.append(_CACHE.pop(path))
+        # handles currently LEASED to an in-flight query are not in
+        # _CACHE, so per-path generation bumps cannot reach them; the
+        # epoch bump makes every handle leased across this sweep
+        # close at checkin instead of re-entering the cache (the
+        # shard_cache_clear discipline, scoped to correctness: a
+        # swept-tree handle must never serve a deleted/rewritten
+        # shard, and over-invalidating unrelated leases costs one
+        # reopen each)
+        _EPOCH[0] += 1
+    with _FIND_LOCK:
+        for d in [d for d in _FIND_CACHE
+                  if os.path.abspath(d) == root or
+                  os.path.abspath(d).startswith(prefix)]:
+            _FIND_CACHE.pop(d)
+    for handle in closing:
+        handle.querier.close()
+
+
+def find_cache_stats():
+    """Size of the whole-tree find memo (`dn serve` /stats)."""
+    with _FIND_LOCK:
+        return {'size': len(_FIND_CACHE)}
 
 
 # -- shard-list (find) cache ----------------------------------------------
@@ -569,6 +619,10 @@ class ShardQueryExecutor(object):
         self.workq = queue.Queue(maxsize=nworkers + self.QUEUE_DEPTH)
         self.resultq = queue.Queue()
         self._stopping = False
+        # workers adopt the submitting request's counter scope so
+        # cache-hit/miss telemetry attributes to the right `dn serve`
+        # request even on the per-shard pool path
+        self._scope = vpipe.current_scope()
         self.threads = []
         for _ in range(nworkers):
             t = threading.Thread(target=self._worker, daemon=True)
@@ -576,6 +630,10 @@ class ShardQueryExecutor(object):
             self.threads.append(t)
 
     def _worker(self):
+        with vpipe.adopt_scope(self._scope):
+            self._worker_loop()
+
+    def _worker_loop(self):
         while True:
             item = self.workq.get()
             if item is None:

@@ -491,11 +491,15 @@ def _device_sums(inv, weights, nuniq):
     return dense[:nuniq].astype(np.float64)
 
 
-def _aggregate_weights(inv, weights, nuniq):
+def _aggregate_weights(inv, weights, nuniq, stage=None):
     from .engine import engine_mode
     if engine_mode() == 'jax':
         dense = _device_sums(inv, weights, nuniq)
         if dense is not None:
+            # hidden (the --counters bytes are pinned): lets `dn
+            # serve` /stats report device-lane engagement per request
+            if stage is not None:
+                stage.bump_hidden('index device sums', 1)
             return dense
     return np.bincount(inv, weights=weights, minlength=nuniq)
 
@@ -636,7 +640,8 @@ def run_stacked(paths, query, aggr, index_list):
     first_idx, inv, order = _unique_rows(acols)
     nuniq = len(first_idx)
 
-    wsum = _aggregate_weights(inv, values[perm], nuniq)
+    wsum = _aggregate_weights(inv, values[perm], nuniq,
+                              stage=index_list)
     rows = first_idx[order]
     out_cols = [np.ascontiguousarray(c[rows]) for c in acols]
     weights = [int(w) for w in wsum[order].tolist()]

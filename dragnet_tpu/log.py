@@ -120,7 +120,8 @@ def root():
     return _root
 
 
-def get(component):
+def get(component, **fields):
     """Child logger for a component (cached root; level from
-    LOG_LEVEL at first use)."""
-    return root().child(component)
+    LOG_LEVEL at first use).  Extra fields ride on every record —
+    `dn serve` uses this for per-request loggers (req=N)."""
+    return root().child(component, **fields)

@@ -251,6 +251,11 @@ class MTScanExecutor(object):
         self.errors = []
         self.seq = 0
         self.worker_pipelines = []
+        # workers adopt the submitting request's counter scope so the
+        # hidden parse/engine telemetry their pipelines mirror still
+        # attributes to the right `dn serve` request
+        from . import vpipe as mod_vpipe
+        self._scope = mod_vpipe.current_scope()
         self.threads = []
         for _ in range(nworkers):
             wp = Pipeline()
@@ -263,6 +268,11 @@ class MTScanExecutor(object):
         self.merger.start()
 
     def _worker(self, build_worker, wp):
+        from . import vpipe as mod_vpipe
+        with mod_vpipe.adopt_scope(self._scope):
+            self._worker_loop(build_worker, wp)
+
+    def _worker_loop(self, build_worker, wp):
         try:
             process = build_worker(wp)
         except BaseException as e:  # surface setup failures at submit

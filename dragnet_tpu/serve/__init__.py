@@ -1,0 +1,16 @@
+"""dn serve: the resident query server.
+
+A long-lived daemon that holds the warm state every prior layer built
+— the shard-handle LRU, the whole-tree find memo, the persisted
+audition verdicts, compiled device executables — and executes
+scan/build/query requests over a newline-JSON socket protocol with
+byte-identical output framing.  Modules:
+
+* server.py    — the multi-threaded daemon + request execution
+* admission.py — bounded admission, deadlines, request coalescing
+* client.py    — the `--remote` thin client with local fallback
+* lifecycle.py — pidfile/socket hygiene, drain, writer invalidation
+
+Import-light on purpose: the heavy modules load lazily so `import
+dragnet_tpu` stays cheap.
+"""

@@ -1,0 +1,275 @@
+"""Admission control, per-request deadlines, and request coalescing
+for `dn serve`.
+
+Three mechanisms keep a resident server healthy under concurrent
+load, in the order a request meets them:
+
+* Coalescing (`Coalescer`): identical in-flight computations — same
+  datasource, same query shape, same config identity — share ONE
+  execution.  The first request in becomes the leader and computes;
+  followers attach and wait for the leader's result (StreamBox-HBM's
+  target-latency batching of concurrent pipeline work, applied to the
+  serving tier).  Compatible requests that differ only in OUTPUT
+  options (--raw vs --points vs pretty vs --counters) coalesce too:
+  the compute key deliberately excludes formatting, and the server
+  demuxes one shared ScanResult through each request's own output
+  path.  Because the shared run goes through the default stacked
+  cross-shard execution (index_query_stack), N concurrent index
+  queries over the same tree cost one stacked aggregation.
+
+* Admission (`Admission`): at most `max_inflight` executions run at
+  once; up to `queue_depth` more may wait for a slot; beyond that the
+  request fails FAST with a 429-style DNError ("server busy") instead
+  of joining an unbounded convoy.  Coalesced followers do not consume
+  slots — attaching to an in-flight execution is the cheap path the
+  whole design exists to reward.
+
+* Deadlines: each request runs under `DN_SERVE_DEADLINE_MS` (or its
+  own `deadline_ms`) on a reaper-armored thread
+  (device_scan.run_with_deadline) — a wedged device op or a
+  pathological query costs the client a bounded wait and a DNError,
+  never a hung connection.  A coalesced follower shares its leader's
+  fate: if the leader's execution times out, every attached request
+  reports the deadline error.
+"""
+
+import json
+import threading
+from contextlib import contextmanager
+
+from ..errors import DNError
+
+
+class BusyError(DNError):
+    """Queue-full fast rejection (the 429 analog)."""
+
+
+class DeadlineError(DNError):
+    """Per-request deadline expiry (the 504 analog)."""
+
+
+class Slot(object):
+    """One admitted execution slot.  release() is IDEMPOTENT: a
+    deadline-expired request's reaper frees the slot immediately while
+    the abandoned job thread's own finally releases again when (if)
+    the wedged operation eventually finishes — only the first call
+    counts, so accounting never goes negative and a permanently
+    wedged op cannot pin a slot forever."""
+
+    __slots__ = ('_admission', '_released')
+
+    def __init__(self, admission):
+        self._admission = admission
+        self._released = False
+
+    def release(self):
+        with self._admission._cond:
+            if self._released:
+                return
+            self._released = True
+            self._admission._inflight -= 1
+            self._admission._cond.notify()
+
+
+class Admission(object):
+    """Bounded execution slots with a bounded waiting room."""
+
+    def __init__(self, max_inflight, queue_depth):
+        self.max_inflight = max_inflight
+        self.queue_depth = queue_depth
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._queued = 0
+
+    def acquire(self):
+        """Take an execution slot, waiting in the bounded queue if
+        needed.  Returns a Slot (release it exactly-or-more-than
+        once).  Raises BusyError immediately when the queue is
+        full."""
+        with self._cond:
+            if self._inflight < self.max_inflight:
+                self._inflight += 1
+                return Slot(self)
+            if self._queued >= self.queue_depth:
+                raise BusyError(
+                    'server busy: %d request(s) in flight, %d queued '
+                    '(DN_SERVE_MAX_INFLIGHT=%d DN_SERVE_QUEUE_DEPTH=%d)'
+                    % (self._inflight, self._queued, self.max_inflight,
+                       self.queue_depth))
+            self._queued += 1
+            try:
+                while self._inflight >= self.max_inflight:
+                    self._cond.wait()
+            finally:
+                self._queued -= 1
+            self._inflight += 1
+            return Slot(self)
+
+    def depth(self):
+        with self._cond:
+            return {'active': self._inflight, 'queued': self._queued,
+                    'max_inflight': self.max_inflight,
+                    'queue_depth': self.queue_depth}
+
+
+class TreeLock(object):
+    """Writer-priority reader/writer lock, one per index tree: index
+    queries hold the read side while they execute, builds hold the
+    write side — so a query never enumerates a tree mid-rewrite (the
+    writer's tmp+rename discipline makes each SHARD atomic, but the
+    tree as a whole grows tmp litter and partial shard sets while a
+    build runs, and a resident server overlaps those freely).  Writer
+    priority keeps a build from starving under a steady query load."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    @contextmanager
+    def read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                self._cond.notify_all()
+
+    @contextmanager
+    def write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()
+
+
+class _Execution(object):
+    __slots__ = ('done', 'value', 'error', 'followers')
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.value = None
+        self.error = None
+        self.followers = 0
+
+
+# followers never wait forever even if a leader thread dies without
+# publishing (a bug, but one that must not strand client connections)
+_FOLLOW_CAP_S = 3600.0
+
+
+class Coalescer(object):
+    """Share one execution across identical in-flight requests.
+
+    run(key, compute) returns (value, shared): the leader executes
+    `compute()` and publishes; followers wait and receive the same
+    value (or re-raise the same error).  The key is removed from the
+    in-flight table BEFORE the result publishes, so a request arriving
+    after completion always starts a fresh execution — this is
+    in-flight sharing only, never a result cache (writer invalidation
+    stays trivial: there is nothing stale to invalidate)."""
+
+    def __init__(self, enabled=True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._inflight = {}
+        self._stats = {'executions': 0, 'coalesced': 0}
+
+    def run(self, key, compute, lease=None):
+        if not self.enabled or key is None:
+            with self._lock:
+                self._stats['executions'] += 1
+            return compute(), False
+        with self._lock:
+            ex = self._inflight.get(key)
+            if ex is None:
+                ex = _Execution()
+                self._inflight[key] = ex
+                self._stats['executions'] += 1
+                leader = True
+            else:
+                ex.followers += 1
+                self._stats['coalesced'] += 1
+                leader = False
+        if not leader:
+            if not ex.done.wait(_FOLLOW_CAP_S):
+                raise DeadlineError('coalesced execution never '
+                                    'completed')
+            if ex.error is not None:
+                raise ex.error
+            return ex.value, True
+        if lease is not None:
+            # the reaper's handle on this execution: a leader whose
+            # request deadline expires must be abandon()ed so new
+            # arrivals recompute instead of attaching to it forever
+            lease['key'] = key
+            lease['ex'] = ex
+        try:
+            ex.value = compute()
+        except BaseException as e:
+            ex.error = e
+            raise
+        finally:
+            with self._lock:
+                # identity-checked: abandon() may have replaced this
+                # key with a fresh execution already
+                if self._inflight.get(key) is ex:
+                    self._inflight.pop(key)
+            ex.done.set()
+        return ex.value, False
+
+    def abandon(self, key, ex):
+        """Retire a leader's in-flight registration after its request
+        deadline expired: the wedged execution must stop attracting
+        followers, and any already attached must wake with the
+        deadline error (they share their leader's fate).  No-op when
+        the execution already completed or was replaced."""
+        if key is None or ex is None:
+            return
+        with self._lock:
+            if self._inflight.get(key) is not ex:
+                return
+            self._inflight.pop(key)
+        if ex.error is None:
+            ex.error = DeadlineError(
+                'coalesced execution abandoned (leader request '
+                'deadline expired)')
+        ex.done.set()
+
+    def stats(self):
+        with self._lock:
+            return dict(self._stats, inflight=len(self._inflight))
+
+
+def compute_key(req, config_ident):
+    """Canonical coalescing key for a data request: everything that
+    determines the COMPUTED result (op, datasource, query document,
+    interval, dry-run, plus the config file's identity so an edited
+    datasource definition never shares with its predecessor) and
+    nothing that only affects output formatting."""
+    if req.get('op') not in ('scan', 'query'):
+        return None              # builds and debug ops never coalesce
+    doc = {
+        'op': req.get('op'),
+        'ds': req.get('ds'),
+        'config': config_ident,
+        'queryconfig': req.get('queryconfig'),
+        'interval': req.get('interval'),
+        'dry_run': bool((req.get('opts') or {}).get('dry_run')),
+    }
+    return json.dumps(doc, sort_keys=True, separators=(',', ':'))

@@ -1,0 +1,173 @@
+"""The `--remote` thin client: ship a parsed request to a resident
+`dn serve`, stream the result bytes back verbatim, and fall back to
+local execution — with a warning — when the server is unreachable.
+
+The client does ALL argument parsing locally (usage errors never
+travel), ships the parsed QueryConfig document plus output options,
+and writes the response's stdout/stderr bytes through this process's
+streams untouched — so remote output is byte-identical to local
+output by construction, and `dn query --remote ... | sort` composes
+exactly like the local pipeline would.
+
+Fallback contract: local execution is only a safe substitute while
+the request has observably NOT run — so the fallback window closes
+the moment the response header arrives.  A transport failure after
+that (server killed mid-response) raises RemoteTransportError
+instead: the server may have already acted (a build!) and response
+bytes may already be on this process's stdout, so re-running locally
+would duplicate both.
+"""
+
+import json
+import os
+import socket
+import sys
+
+from ..errors import DNError
+
+CHUNK = 1 << 16
+
+
+class RemoteTransportError(DNError):
+    """The connection died AFTER the server committed a response —
+    too late to fall back to local execution."""
+
+
+def parse_addr(value):
+    """'--remote' address forms: a unix socket path, or HOST:PORT /
+    :PORT for TCP."""
+    if value and os.sep not in value and ':' in value:
+        host, _, port = value.rpartition(':')
+        if port.isdigit():
+            return ('tcp', host or '127.0.0.1', int(port))
+    return ('unix', value, None)
+
+
+def _connect(value, timeout_s):
+    kind, a, b = parse_addr(value)
+    if kind == 'tcp':
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        addr = (a, b)
+    else:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        addr = a
+    sock.settimeout(timeout_s)
+    sock.connect(addr)
+    return sock
+
+
+def _open_request(remote, req, timeout_s):
+    """Connect, send one request line, read the response header.
+    Everything in here is the pre-commit phase: failures raise plain
+    OSError/ValueError and falling back to local execution is safe.
+    Returns (header, response_file, sock)."""
+    sock = _connect(remote, timeout_s)
+    try:
+        sock.sendall(json.dumps(req).encode() + b'\n')
+        f = sock.makefile('rb')
+        line = f.readline()
+        if not line:
+            raise OSError('server closed the connection before '
+                          'responding')
+        return json.loads(line.decode('utf-8')), f, sock
+    except BaseException:
+        sock.close()
+        raise
+
+
+def _read_exact(f, size):
+    """Read exactly `size` payload bytes in chunks, yielding each;
+    post-commit, so truncation is a RemoteTransportError."""
+    left = size
+    while left > 0:
+        try:
+            chunk = f.read(min(CHUNK, left))
+        except OSError as e:
+            raise RemoteTransportError(
+                'remote response interrupted mid-payload',
+                cause=DNError(str(e)))
+        if not chunk:
+            raise RemoteTransportError('remote response truncated '
+                                       'mid-payload')
+        yield chunk
+        left -= len(chunk)
+
+
+def _roundtrip(remote, req, timeout_s):
+    """One buffered request/response exchange: returns (header,
+    stdout_bytes, stderr_bytes)."""
+    header, f, sock = _open_request(remote, req, timeout_s)
+    try:
+        out = b''.join(_read_exact(f, header.get('nout', 0)))
+        err = b''.join(_read_exact(f, header.get('nerr', 0)))
+        return header, out, err
+    finally:
+        sock.close()
+
+
+def _write_bytes(stream, data):
+    """Verbatim byte pass-through: the underlying binary buffer when
+    the stream has one (flushing pending text first so ordering
+    holds), a decode otherwise (StringIO capture harnesses)."""
+    if not data:
+        return
+    buf = getattr(stream, 'buffer', None)
+    try:
+        stream.flush()
+    except Exception:
+        pass
+    if buf is not None:
+        buf.write(data)
+        buf.flush()
+    else:
+        stream.write(data.decode('utf-8', 'replace'))
+
+
+def request(remote, req, timeout_s=None):
+    """Send one request and stream the response through this
+    process's stdout/stderr.  Returns the remote exit code.  Raises
+    OSError while falling back is still safe (pre-header), and
+    RemoteTransportError once it is not."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get('DN_SERVE_CLIENT_TIMEOUT_S',
+                                         '3600'))
+    header, f, sock = _open_request(remote, req, timeout_s)
+    try:
+        for size, stream in ((header.get('nout', 0), sys.stdout),
+                             (header.get('nerr', 0), sys.stderr)):
+            for chunk in _read_exact(f, size):
+                _write_bytes(stream, chunk)
+        return int(header.get('rc', 1))
+    finally:
+        sock.close()
+
+
+def request_bytes(remote, req, timeout_s=60.0):
+    """request() for harnesses: returns (rc, header, stdout_bytes,
+    stderr_bytes) instead of writing through the process streams."""
+    header, out, err = _roundtrip(remote, req, timeout_s)
+    return int(header.get('rc', 1)), header, out, err
+
+
+def run_or_fallback(remote, req):
+    """request() with the unreachable-server contract: on a
+    PRE-COMMIT failure (connect/send/header) print the fallback
+    warning and return None so the caller runs the command locally.
+    Post-commit transport failures (RemoteTransportError) propagate —
+    the server already acted and bytes may already be on stdout."""
+    try:
+        return request(remote, req)
+    except RemoteTransportError:
+        raise
+    except (OSError, ValueError) as e:
+        sys.stderr.write(
+            'dn: warning: serve endpoint "%s" unreachable (%s); '
+            'falling back to local execution\n'
+            % (remote, getattr(e, 'strerror', None) or e))
+        return None
+
+
+def stats(remote, timeout_s=5.0):
+    """Fetch and parse the server's /stats document (bench + tests)."""
+    header, out, err = _roundtrip(remote, {'op': 'stats'}, timeout_s)
+    return json.loads(out.decode('utf-8'))

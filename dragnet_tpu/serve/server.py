@@ -1,0 +1,682 @@
+"""The `dn serve` daemon: a long-lived multi-threaded server that
+executes scan/build/query requests with warm process state.
+
+Every `dn query` today pays full cold start — interpreter boot, jit
+compilation, shard-handle/find-memo/audition-cache warm-up — per
+invocation.  The warm-path machinery only earns its keep when one
+process lives across requests; this server is that process.  It holds:
+
+* the shard-handle LRU + whole-tree find memo (index_query_mt),
+* the persisted audition-verdict cache and compiled device
+  executables (device_scan / ops),
+* the stacked cross-shard execution path (index_query_stack), which
+  request coalescing (admission.py) turns into one aggregation for N
+  compatible concurrent queries.
+
+Protocol: newline-JSON over a unix socket (TCP optional), one request
+per connection.  Request: one JSON line, e.g.
+
+    {"op": "query", "ds": "muskie", "config": "/path/.dragnetrc",
+     "queryconfig": {"breakdowns": [...], "filter": ...},
+     "interval": "day", "opts": {"raw": false, "counters": true}}
+
+Response: one JSON header line {"ok": bool, "rc": int, "nout": N,
+"nerr": M, "stats": {...}} followed by exactly N stdout bytes and M
+stderr bytes.  The payload bytes are BYTE-IDENTICAL to what the local
+CLI command would have written — requests execute through the same
+datasource entry points and the same output layer, with each worker
+thread's stdout/stderr routed to per-request buffers (the thread-stdio
+router below), and coalesced requests demuxed through private
+ScanResult clones.
+
+Ops: scan, query, build, stats, ping (+ a `_sleep` debug op when
+DN_SERVE_TEST_OPS=1, used by the lifecycle tests to hold slots).
+"""
+
+import codecs
+import contextlib
+import io
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+from .. import cli as mod_cli
+from .. import config as mod_config
+from .. import vpipe as mod_vpipe
+from .. import index_query_mt as mod_iqmt
+from .. import log as mod_log
+from ..errors import DNError
+from ..watchdog import LeakCheck
+from . import admission as mod_admission
+from . import lifecycle as mod_lifecycle
+
+MAX_REQUEST_BYTES = 1 << 24
+
+# a server that exits while `running` never drained: in-flight
+# requests (and their clients) may have been dropped on the floor
+_SERVER_LEAKS = LeakCheck(
+    'dn serve server(s) never drained; in-flight requests may have '
+    'been dropped', lambda s: s.running)
+
+
+# -- output-encoding parity with bin/dn.py ----------------------------------
+
+def _dn_fffd(err):
+    return ('�' * (err.end - err.start), err.end)
+
+
+def output_errors():
+    """The error handler name request buffers encode with — the same
+    lone-surrogate -> U+FFFD behavior bin/dn.py installs on the real
+    stdout, so response bytes match the CLI's byte-for-byte."""
+    try:
+        codecs.lookup_error('dn_fffd')
+    except LookupError:
+        codecs.register_error('dn_fffd', _dn_fffd)
+    return 'dn_fffd'
+
+
+# -- thread-directed stdio --------------------------------------------------
+#
+# The CLI output layer writes to sys.stdout / sys.stderr directly, and
+# that is exactly what guarantees byte parity — so instead of
+# refactoring every write site, the server routes the PROCESS streams
+# through a per-thread binding: worker threads bind their request
+# buffers, every other thread falls through to the real stream.  The
+# binding registry is module-global (not per-router-instance) so a
+# router displaced by test harnesses that swap sys.stdout can be
+# reinstalled at any time without stranding live bindings.
+
+_STDIO_TLS = threading.local()
+_STDIO_LOCK = threading.Lock()
+
+
+class _ThreadStream(object):
+    def __init__(self, which, fallback):
+        self._which = which
+        self._fallback = fallback
+
+    def _target(self):
+        bound = getattr(_STDIO_TLS, self._which, None)
+        return self._fallback if bound is None else bound
+
+    def write(self, data):
+        return self._target().write(data)
+
+    def writelines(self, lines):
+        return self._target().writelines(lines)
+
+    def flush(self):
+        return self._target().flush()
+
+    def __getattr__(self, name):
+        return getattr(self._target(), name)
+
+
+def install_stdio_router():
+    """Idempotently route sys.stdout/sys.stderr through the
+    thread-binding proxies (re-wrapping whatever stream is current if
+    something replaced them since the last install)."""
+    with _STDIO_LOCK:
+        if not isinstance(sys.stdout, _ThreadStream):
+            sys.stdout = _ThreadStream('out', sys.stdout)
+        if not isinstance(sys.stderr, _ThreadStream):
+            sys.stderr = _ThreadStream('err', sys.stderr)
+
+
+class _Capture(object):
+    """Per-request byte buffers presented as text streams (utf-8 with
+    the CLI's surrogate policy)."""
+
+    def __init__(self):
+        errors = output_errors()
+        self.out_b = io.BytesIO()
+        self.err_b = io.BytesIO()
+        self.out_t = io.TextIOWrapper(self.out_b, encoding='utf-8',
+                                      errors=errors, newline='')
+        self.err_t = io.TextIOWrapper(self.err_b, encoding='utf-8',
+                                      errors=errors, newline='')
+
+    def finish(self):
+        """Flush and return (stdout_bytes, stderr_bytes); the buffers
+        detach so the text wrappers' GC cannot close them early."""
+        self.out_t.flush()
+        self.err_t.flush()
+        out, err = self.out_b.getvalue(), self.err_b.getvalue()
+        self.out_t.detach()
+        self.err_t.detach()
+        return out, err
+
+
+@contextlib.contextmanager
+def bound_stdio(capture):
+    """Bind THIS thread's sys.stdout/sys.stderr to the capture."""
+    install_stdio_router()
+    prior = (getattr(_STDIO_TLS, 'out', None),
+             getattr(_STDIO_TLS, 'err', None))
+    _STDIO_TLS.out = capture.out_t
+    _STDIO_TLS.err = capture.err_t
+    try:
+        yield
+    finally:
+        _STDIO_TLS.out, _STDIO_TLS.err = prior
+
+
+@contextlib.contextmanager
+def thread_stdio():
+    """Capture this thread's CLI output as bytes (tests use this to
+    compute expected local bytes through the same router the server
+    routes through): yields the _Capture; read via .finish()."""
+    cap = _Capture()
+    with bound_stdio(cap):
+        yield cap
+
+
+# -- request options shim ---------------------------------------------------
+
+class _ReqOpts(object):
+    """The parsed-options surface cli.dn_query_config / cli.dn_output
+    expect, rebuilt from a request's shipped documents."""
+
+
+def _opts_shim(req):
+    o = _ReqOpts()
+    qc = req.get('queryconfig') or {}
+    o.breakdowns = qc.get('breakdowns') or []
+    o.after = qc.get('timeAfter')
+    o.before = qc.get('timeBefore')
+    o.filter = qc.get('filter')
+    opts = req.get('opts') or {}
+    for name in ('raw', 'points', 'counters', 'gnuplot'):
+        setattr(o, name, opts.get(name))
+    o.dry_run = bool(opts.get('dry_run'))
+    o.interval = req.get('interval')
+    return o
+
+
+def _config_ident(path):
+    try:
+        st = os.stat(path)
+        return [path, st.st_mtime_ns, st.st_size]
+    except OSError:
+        return [path, None, None]
+
+
+_DEVICE_SIGNALS = ('ndevicebatches', 'nstackedbatches',
+                   'index device sums')
+
+
+def device_engaged(counters):
+    return any(counters.get(k) for k in _DEVICE_SIGNALS)
+
+
+# -- the server -------------------------------------------------------------
+
+class DnServer(object):
+    def __init__(self, socket_path=None, port=None, host='127.0.0.1',
+                 conf=None, pidfile=None):
+        if conf is None:
+            conf = mod_config.serve_config()
+        if isinstance(conf, DNError):
+            raise conf
+        assert (socket_path is None) != (port is None), \
+            'exactly one of socket_path/port'
+        self.conf = conf
+        self.socket_path = socket_path
+        self.port = port
+        self.host = host
+        self.pidfile = pidfile
+        self.bound_port = None
+        self.admission = mod_admission.Admission(conf['max_inflight'],
+                                                 conf['queue_depth'])
+        self.coalescer = mod_admission.Coalescer(conf['coalesce'])
+        self.log = mod_log.get('serve')
+        self.running = False
+        self._listener = None
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._workers = set()
+        self._workers_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._counters = {'requests': 0, 'errors': 0,
+                          'busy_rejected': 0, 'deadline_expired': 0}
+        self._by_op = {}
+        self._t0 = time.time()
+        self._hook = None
+        self._thread = None
+        # per-index-tree reader/writer locks (admission.TreeLock):
+        # index queries read-lock, builds write-lock — concurrent
+        # builds over one tree would race on the writer's per-PID tmp
+        # names (one process = one pid), and a query walking a tree
+        # mid-rewrite would see tmp litter and partial shard sets
+        self._tree_locks = {}
+        self._tree_locks_lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def bind(self):
+        if self.socket_path is not None:
+            listener = socket.socket(socket.AF_UNIX,
+                                     socket.SOCK_STREAM)
+            listener.bind(self.socket_path)
+        else:
+            listener = socket.socket(socket.AF_INET,
+                                     socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET,
+                                socket.SO_REUSEADDR, 1)
+            listener.bind((self.host, self.port))
+            self.bound_port = listener.getsockname()[1]
+        listener.listen(128)
+        listener.settimeout(0.25)
+        self._listener = listener
+        self.running = True
+        _SERVER_LEAKS.track(self)
+        self._hook = mod_lifecycle.install_writer_invalidation()
+        self.log.info('listening',
+                      socket=self.socket_path, port=self.bound_port,
+                      max_inflight=self.conf['max_inflight'])
+
+    def serve_forever(self):
+        """Accept loop (blocks until request_stop); drains on exit:
+        stop accepting, finish in-flight, flush caches, unlink the
+        socket."""
+        install_stdio_router()
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                t = threading.Thread(target=self._handle_conn,
+                                     args=(conn,), daemon=True)
+                with self._workers_lock:
+                    self._workers.add(t)
+                t.start()
+        finally:
+            self._drain()
+
+    def start(self):
+        """Embedded mode (tests, benchmarks): bind if needed and run
+        the accept loop on a background thread."""
+        if self._listener is None:
+            self.bind()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def request_stop(self):
+        self._stop.set()
+
+    def stop(self, wait=True):
+        self.request_stop()
+        if self._thread is not None and wait:
+            self._thread.join(self.conf['drain_s'] + 5)
+        elif wait:
+            self._drained.wait(self.conf['drain_s'] + 5)
+
+    def _drain(self):
+        if self._drained.is_set():
+            return
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + self.conf['drain_s']
+        with self._workers_lock:
+            workers = list(self._workers)
+        for t in workers:
+            t.join(max(0.0, deadline - time.monotonic()))
+        leftover = sum(1 for t in workers if t.is_alive())
+        if leftover:
+            self.log.warn('drain grace expired', abandoned=leftover)
+        # flush warm state cleanly: cached shard handles hold open
+        # mmaps / sqlite connections
+        mod_iqmt.shard_cache_clear()
+        if self._hook is not None:
+            mod_lifecycle.remove_writer_invalidation(self._hook)
+            self._hook = None
+        mod_lifecycle.release(socket_path=self.socket_path,
+                              pidfile=self.pidfile)
+        self.running = False
+        _SERVER_LEAKS.untrack(self)
+        self._drained.set()
+        self.log.info('drained', requests=self._counters['requests'])
+
+    # -- stats ------------------------------------------------------------
+
+    def _bump(self, name, n=1):
+        with self._stats_lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def _bump_op(self, op):
+        with self._stats_lock:
+            self._counters['requests'] += 1
+            self._by_op[op] = self._by_op.get(op, 0) + 1
+
+    def stats_doc(self):
+        counters = mod_vpipe.global_counters()
+        with self._stats_lock:
+            requests = dict(self._counters, by_op=dict(self._by_op))
+        requests.update(self.coalescer.stats())
+        doc = {
+            'pid': os.getpid(),
+            'uptime_s': round(time.time() - self._t0, 3),
+            'socket': self.socket_path,
+            'port': self.bound_port,
+            'requests': requests,
+            'inflight': self.admission.depth(),
+            'caches': {
+                'shard_handles': mod_iqmt.shard_cache_stats(),
+                'find_memo': mod_iqmt.find_cache_stats(),
+            },
+            'counters': counters,
+            'device': {
+                'engaged': device_engaged(counters),
+                'signals': {k: counters.get(k, 0)
+                            for k in _DEVICE_SIGNALS},
+            },
+        }
+        try:
+            from ..device_scan import _audition_cache_file
+            doc['caches']['audition_verdicts'] = _audition_cache_file()
+        except Exception:
+            pass
+        return doc
+
+    # -- request handling -------------------------------------------------
+
+    def _handle_conn(self, conn):
+        try:
+            conn.settimeout(60)
+            f = conn.makefile('rb')
+            line = f.readline(MAX_REQUEST_BYTES)
+            if not line:
+                return
+            try:
+                req = json.loads(line.decode('utf-8'))
+                if not isinstance(req, dict):
+                    raise ValueError('not an object')
+            except (ValueError, UnicodeDecodeError) as e:
+                self._respond(conn, 1, b'',
+                              ('dn: bad request: %s\n' % e).encode(),
+                              {})
+                return
+            rc, out, err, extra = self.execute(req)
+            self._respond(conn, rc, out, err, extra)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._workers_lock:
+                self._workers.discard(threading.current_thread())
+
+    def _respond(self, conn, rc, out, err, extra):
+        header = {'ok': rc == 0, 'rc': rc, 'nout': len(out),
+                  'nerr': len(err), 'stats': extra}
+        conn.sendall(json.dumps(header, sort_keys=True).encode() +
+                     b'\n' + out + err)
+
+    def execute(self, req):
+        """Execute one request dict; returns (rc, stdout_bytes,
+        stderr_bytes, header_stats)."""
+        op = req.get('op')
+        self._bump_op(op)
+        if op == 'ping':
+            return 0, b'', b'', {}
+        if op == 'stats':
+            body = json.dumps(self.stats_doc(), sort_keys=True,
+                              indent=2) + '\n'
+            return 0, body.encode(), b'', {}
+        if op in ('scan', 'query', 'build') or \
+                (op == '_sleep' and
+                 os.environ.get('DN_SERVE_TEST_OPS') == '1'):
+            return self._execute_data(req)
+        self._bump('errors')
+        return (1, b'',
+                ('dn: unsupported request op: "%s"\n' % op).encode(),
+                {})
+
+    def _execute_data(self, req):
+        t0 = time.monotonic()
+        deadline_ms = req.get('deadline_ms')
+        if deadline_ms is None:
+            deadline_ms = self.conf['deadline_ms']
+        cap = _Capture()
+        flags = {'coalesced': False, 'busy': False, 'deadline': False}
+        scope_out = {}
+
+        def job():
+            # may run on the worker thread OR a deadline-armor
+            # thread: stdio binding and the counter scope are
+            # thread-local, so both bind in here
+            with bound_stdio(cap), mod_vpipe.request_scope() as sc:
+                try:
+                    rc = self._run_data(req, flags)
+                except mod_admission.BusyError as e:
+                    flags['busy'] = True
+                    sys.stderr.write('%s: %s\n'
+                                     % (mod_cli.ARG0, e.message))
+                    rc = 1
+                except mod_admission.DeadlineError as e:
+                    flags['deadline'] = True
+                    sys.stderr.write('%s: %s\n'
+                                     % (mod_cli.ARG0, e.message))
+                    rc = 1
+                except mod_cli.FatalError as e:
+                    sys.stderr.write('%s: %s\n'
+                                     % (mod_cli.ARG0, e.message))
+                    rc = 1
+                except DNError as e:
+                    sys.stderr.write('%s: %s\n'
+                                     % (mod_cli.ARG0, e.message))
+                    rc = 1
+                except Exception as e:
+                    self.log.error('request failed', err=repr(e),
+                                   op=req.get('op'))
+                    sys.stderr.write('%s: internal error: %r\n'
+                                     % (mod_cli.ARG0, e))
+                    rc = 1
+                scope_out.update(sc)
+            return rc
+
+        if deadline_ms and deadline_ms > 0:
+            from ..device_scan import run_with_deadline
+            status, rv = run_with_deadline(job, deadline_ms / 1000.0,
+                                           'serve-request')
+            if status == 'timeout':
+                # the job thread is abandoned (there is no way to
+                # cancel a wedged op), but its resources must not
+                # degrade the server: free its admission slot now
+                # (Slot.release is idempotent — the abandoned thread
+                # releasing again later is a no-op) and retire its
+                # coalescer registration so identical new requests
+                # recompute instead of attaching to a dead execution.
+                # A TreeLock held by an abandoned BUILD stays held on
+                # purpose — the tree is mid-rewrite and must not be
+                # served until the write actually finishes.
+                slot = flags.get('slot')
+                if slot is not None:
+                    slot.release()
+                self.coalescer.abandon(flags.get('key'),
+                                       flags.get('ex'))
+                self._bump('deadline_expired')
+                self._bump('errors')
+                msg = ('%s: request deadline (%d ms) exceeded\n'
+                       % (mod_cli.ARG0, deadline_ms))
+                return 1, b'', msg.encode(), {'deadline_expired': True}
+            rc = rv if status == 'ok' else 1
+        else:
+            rc = job()
+
+        out, err = cap.finish()
+        if rc != 0:
+            self._bump('errors')
+        if flags['busy']:
+            self._bump('busy_rejected')
+        if flags['deadline']:
+            self._bump('deadline_expired')
+        extra = {
+            'coalesced': flags['coalesced'],
+            'elapsed_ms': round((time.monotonic() - t0) * 1000, 3),
+            'counters': scope_out,
+        }
+        return rc, out, err, extra
+
+    def _tree_lock(self, ds, dsname):
+        # normalized, so '/data/idx' and '/data/idx/' (or a relative
+        # spelling via a different config file) share ONE lock — two
+        # locks for one tree would readmit the build/query race
+        key = getattr(ds, 'ds_indexpath', None)
+        key = os.path.abspath(key) if key else ('ds:' + str(dsname))
+        with self._tree_locks_lock:
+            return self._tree_locks.setdefault(
+                key, mod_admission.TreeLock())
+
+    def _run_data(self, req, flags):
+        """The data-command body, mirroring the CLI's post-parse
+        execution exactly (the client already did the parsing and
+        ships the parsed documents).  Raises FatalError/DNError for
+        the caller to frame as 'dn: <message>'."""
+        op = req['op']
+        if op == '_sleep':
+            flags['slot'] = self.admission.acquire()
+            try:
+                time.sleep(float(req.get('ms', 0)) / 1000.0)
+            finally:
+                flags['slot'].release()
+            return 0
+
+        from .. import datasource_for_name, metrics_for_index
+        cfg_path = req.get('config') or None
+        backend = mod_config.ConfigBackendLocal(cfg_path)
+        err, config = backend.load()
+        if err is not None and not getattr(err, 'is_enoent', False):
+            mod_cli.fatal(err)
+        dsname = req.get('ds')
+        ds = datasource_for_name(config, dsname)
+        if isinstance(ds, DNError):
+            mod_cli.fatal(ds)
+        opts = _opts_shim(req)
+
+        if op == 'build':
+            return self._run_build(req, ds, config, dsname, opts,
+                                   metrics_for_index, flags)
+
+        query = mod_cli.dn_query_config(opts)
+        key = mod_admission.compute_key(
+            req, _config_ident(backend.cbl_path))
+
+        def compute():
+            slot = flags['slot'] = self.admission.acquire()
+            try:
+                if op == 'scan':
+                    # raw-data scans never read the index tree, so
+                    # they run unlocked alongside builds
+                    return ds.scan(query, dry_run=opts.dry_run,
+                                   warn_func=None)
+                with self._tree_lock(ds, dsname).read():
+                    return ds.query(query,
+                                    req.get('interval') or 'day',
+                                    dry_run=opts.dry_run)
+            finally:
+                slot.release()
+
+        try:
+            result, shared = self.coalescer.run(key, compute,
+                                                lease=flags)
+        except (mod_admission.BusyError,
+                mod_admission.DeadlineError):
+            raise
+        except DNError as e:
+            mod_cli.fatal(e)
+        flags['coalesced'] = shared
+        # coalesced requests demux through private clones: the output
+        # layer mutates the pipeline it formats
+        mod_cli.dn_output(query, opts, result.clone_for_output(),
+                          dsname)
+        return 0
+
+    def _run_build(self, req, ds, config, dsname, opts,
+                   metrics_for_index, flags):
+        before, after = req.get('before'), req.get('after')
+        if before is not None and after is not None and \
+                before < after:
+            mod_cli.fatal(DNError(
+                '"before" time cannot be before "after" time'))
+        interval = req.get('interval') or 'day'
+        if interval not in ('hour', 'day', 'all'):
+            mod_cli.fatal(DNError('interval not supported: "%s"'
+                                  % interval))
+        metrics = metrics_for_index(config, dsname,
+                                    index_config=req.get(
+                                        'index_config'))
+        if len(metrics) == 0:
+            mod_cli.fatal(DNError('no metrics defined for dataset '
+                                  '"%s"' % dsname))
+        slot = flags['slot'] = self.admission.acquire()
+        try:
+            with self._tree_lock(ds, dsname).write():
+                result = ds.build(metrics, interval,
+                                  time_after=after,
+                                  time_before=before,
+                                  dry_run=opts.dry_run,
+                                  warn_func=None)
+        except DNError as e:
+            mod_cli.fatal(e)
+        finally:
+            slot.release()
+        if opts.dry_run:
+            mod_cli.dn_output(None, opts, result, dsname)
+            return 0
+        sys.stderr.write('indexes for "%s" built\n' % dsname)
+        if getattr(opts, 'counters', None):
+            result.pipeline.dump_counters(sys.stderr)
+        return 0
+
+
+# -- daemon entry (cmd_serve) -----------------------------------------------
+
+def serve_main(socket_path=None, port=None, pidfile=None):
+    """Run the daemon until SIGTERM/SIGINT, then drain.  Returns the
+    process exit code."""
+    conf = mod_config.serve_config()
+    if isinstance(conf, DNError):
+        raise conf
+    pidfile = mod_lifecycle.pidfile_for(socket_path, pidfile)
+
+    def warn(msg):
+        sys.stderr.write('dn serve: %s\n' % msg)
+
+    mod_lifecycle.claim(socket_path=socket_path, port=port,
+                        pidfile=pidfile, warn=warn)
+    server = DnServer(socket_path=socket_path, port=port,
+                      pidfile=pidfile, conf=conf)
+    try:
+        server.bind()
+    except OSError as e:
+        mod_lifecycle.release(socket_path=None, pidfile=pidfile)
+        raise DNError('cannot bind serve endpoint',
+                      cause=DNError(str(e)))
+
+    def on_signal(signo, frame):
+        server.request_stop()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    where = socket_path if socket_path is not None \
+        else '%s:%d' % (server.host, server.bound_port)
+    sys.stderr.write('dn serve: listening on %s (pid %d)\n'
+                     % (where, os.getpid()))
+    server.serve_forever()
+    sys.stderr.write('dn serve: drained; exiting\n')
+    return 0

@@ -113,6 +113,48 @@ def test_parse_bench_extras_smoke(tmp_path, monkeypatch):
         assert out['parse_device_records_per_sec'] > 0
 
 
+# -- serve legs: tier-1-safe smoke -----------------------------------------
+
+def test_serve_bench_smoke(tmp_path, monkeypatch):
+    """A miniature --serve-only run: cold CLI subprocess vs a real
+    warm `dn serve` daemon, with the acceptance figures (warm p50 vs
+    cold p50, byte-identical output, device_path_engaged from /stats)
+    landing in the extras."""
+    monkeypatch.setenv('DN_BENCH_SERVE_RECORDS', '4000')
+    monkeypatch.setenv('DN_BENCH_SERVE_DAYS', '20')
+    monkeypatch.setenv('DN_BENCH_SERVE_COLD_REPS', '1')
+    monkeypatch.setenv('DN_BENCH_SERVE_WARM_REPS', '5')
+    monkeypatch.setenv('DN_BENCH_SERVE_BURST', '4')
+    sv = bench.serve_bench(str(tmp_path))
+    assert sv['serve_shards'] == 20
+    assert sv['serve_query_warm_p50_ms'] > 0
+    assert sv['serve_query_cold_cli_p50_ms'] > 0
+    # the acceptance bar: warm-server p50 at most half the cold CLI
+    # process p50 (in practice the gap is orders of magnitude — the
+    # cold side pays interpreter boot + imports per query)
+    assert sv['serve_query_warm_p50_ms'] <= \
+        0.5 * sv['serve_query_cold_cli_p50_ms']
+    assert sv['serve_output_byte_identical'] is True
+    assert sv['serve_coalesced_requests'] >= 0
+    assert isinstance(sv['device_path_engaged'], bool)
+    assert sv['serve_drained_clean'] is True
+
+
+@pytest.mark.slow
+def test_main_serve_emits_json_line(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv('DN_BENCH_SERVE_RECORDS', '4000')
+    monkeypatch.setenv('DN_BENCH_SERVE_DAYS', '10')
+    monkeypatch.setenv('DN_BENCH_SERVE_COLD_REPS', '1')
+    monkeypatch.setenv('DN_BENCH_SERVE_WARM_REPS', '3')
+    bench.main_serve()
+    import json
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    doc = json.loads(line)
+    assert doc['metric'] == 'serve_query_warm_p50_ms'
+    assert doc['value'] > 0
+    assert 'device_path_engaged' in doc['extra']
+
+
 @pytest.mark.slow
 def test_main_parse_emits_json_line(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv('DN_BENCH_PARSE_RECORDS', '20000')

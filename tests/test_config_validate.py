@@ -136,6 +136,40 @@ def test_metric_entry_shape():
         'number is required'
 
 
+# -- DN_SERVE_* knob validation (dn serve / --validate) --------------------
+
+def test_serve_config_defaults():
+    conf = mod_config.serve_config(env={})
+    assert conf == {'max_inflight': 4, 'queue_depth': 16,
+                    'deadline_ms': 0, 'coalesce': True, 'drain_s': 30}
+
+
+def test_serve_config_parses_overrides():
+    conf = mod_config.serve_config(env={
+        'DN_SERVE_MAX_INFLIGHT': '2', 'DN_SERVE_QUEUE_DEPTH': '0',
+        'DN_SERVE_DEADLINE_MS': '1500', 'DN_SERVE_COALESCE': '0',
+        'DN_SERVE_DRAIN_S': '5'})
+    assert conf == {'max_inflight': 2, 'queue_depth': 0,
+                    'deadline_ms': 1500, 'coalesce': False,
+                    'drain_s': 5}
+
+
+def test_serve_config_rejects_bad_values():
+    err = mod_config.serve_config(env={'DN_SERVE_MAX_INFLIGHT': 'x'})
+    assert isinstance(err, DNError)
+    assert str(err) == ('DN_SERVE_MAX_INFLIGHT: expected an integer '
+                        '>= 1, got "x"')
+    err = mod_config.serve_config(env={'DN_SERVE_MAX_INFLIGHT': '0'})
+    assert isinstance(err, DNError)
+    err = mod_config.serve_config(env={'DN_SERVE_QUEUE_DEPTH': '-1'})
+    assert isinstance(err, DNError)
+    assert str(err) == ('DN_SERVE_QUEUE_DEPTH: expected an integer '
+                        '>= 0, got "-1"')
+    err = mod_config.serve_config(env={'DN_SERVE_COALESCE': 'yes'})
+    assert isinstance(err, DNError)
+    assert str(err) == 'DN_SERVE_COALESCE: expected 0 or 1, got "yes"'
+
+
 def test_backend_load_returns_fresh_config_on_error(tmp_path):
     p = tmp_path / 'rc'
     p.write_text('{"vmaj": 0, "vmin": 0, "datasources": [{}], '

@@ -1,0 +1,643 @@
+"""`dn serve` — the resident query server (dragnet_tpu/serve/).
+
+Covers: byte-identity of remote responses vs the sequential local CLI
+(including a concurrent soak over both index formats), request
+coalescing observable via /stats, queue-full and deadline DNError
+paths, remote-unreachable fallback, the request-scoped counter
+machinery, lifecycle hygiene (stale pidfile / orphaned socket
+reclaim), the SIGTERM drain contract, and `dn serve --validate`.
+"""
+
+import json
+import os
+import signal
+import socket as mod_socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import cli                                # noqa: E402
+from dragnet_tpu import vpipe as mod_vpipe                 # noqa: E402
+from dragnet_tpu.errors import DNError                     # noqa: E402
+from dragnet_tpu.serve import admission as mod_admission   # noqa: E402
+from dragnet_tpu.serve import client as mod_client         # noqa: E402
+from dragnet_tpu.serve import lifecycle as mod_lifecycle   # noqa: E402
+from dragnet_tpu.serve import server as mod_server         # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(args):
+    """One in-process CLI run with its stdout/stderr captured as bytes
+    through the serve layer's thread-stdio router — safe to call from
+    multiple threads at once (each gets its own buffers), which is
+    exactly how the soak drives the remote client."""
+    with mod_server.thread_stdio() as cap:
+        rc = cli.main(list(args))
+    out, err = cap.finish()
+    return rc, out, err
+
+
+def _gen_corpus(path, n=400):
+    """Deterministic newline-JSON over 4 days of 2014-01."""
+    import datetime
+    t0 = 1388534400  # 2014-01-01T00:00:00Z
+    with open(path, 'w') as f:
+        for i in range(n):
+            ts = datetime.datetime.utcfromtimestamp(
+                t0 + i * 800).strftime('%Y-%m-%dT%H:%M:%S.000Z')
+            f.write(json.dumps({
+                'time': ts,
+                'host': 'host%d' % (i % 3),
+                'operation': ('get', 'put', 'index')[i % 3],
+                'req': {'method': ('GET', 'PUT')[i % 2]},
+                'latency': (i * 7) % 230,
+            }, separators=(',', ':')) + '\n')
+
+
+@pytest.fixture(scope='module')
+def corpus(tmp_path_factory):
+    """Two datasources over one corpus — ds_dnc / ds_sq with separate
+    index trees built under each DN_INDEX_FORMAT — plus the shared
+    DRAGNET_CONFIG file every CLI run and server request uses."""
+    root = tmp_path_factory.mktemp('serve_corpus')
+    datafile = str(root / 'data.log')
+    _gen_corpus(datafile)
+    rc_path = str(root / 'dragnetrc.json')
+    prior = os.environ.get('DRAGNET_CONFIG')
+    os.environ['DRAGNET_CONFIG'] = rc_path
+    prior_fmt = os.environ.get('DN_INDEX_FORMAT')
+    try:
+        for ds, fmt in (('ds_dnc', 'dnc'), ('ds_sq', 'sqlite')):
+            idx = str(root / ('idx_' + fmt))
+            rc, out, err = run_cli([
+                'datasource-add', '--path', datafile,
+                '--index-path', idx, '--time-field', 'time', ds])
+            assert rc == 0, err
+            rc, out, err = run_cli([
+                'metric-add', '-b',
+                'timestamp[date,field=time,aggr=lquantize,'
+                'step=86400],host,latency[aggr=quantize]', ds, 'm1'])
+            assert rc == 0, err
+            rc, out, err = run_cli([
+                'metric-add', '-b', 'operation', '-f',
+                '{"eq": ["req.method", "GET"]}', ds, 'm2'])
+            assert rc == 0, err
+            os.environ['DN_INDEX_FORMAT'] = fmt
+            rc, out, err = run_cli(['build', ds])
+            assert rc == 0, err
+        yield {'root': root, 'rc_path': rc_path,
+               'datafile': datafile, 'dss': ['ds_dnc', 'ds_sq']}
+    finally:
+        if prior_fmt is None:
+            os.environ.pop('DN_INDEX_FORMAT', None)
+        else:
+            os.environ['DN_INDEX_FORMAT'] = prior_fmt
+        if prior is None:
+            os.environ.pop('DRAGNET_CONFIG', None)
+        else:
+            os.environ['DRAGNET_CONFIG'] = prior
+
+
+def _conf(**over):
+    base = {'max_inflight': 4, 'queue_depth': 16, 'deadline_ms': 0,
+            'coalesce': True, 'drain_s': 10}
+    base.update(over)
+    return base
+
+
+@pytest.fixture
+def server(corpus, tmp_path):
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(socket_path=sock, conf=_conf()).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def _req(ds, corpus, breakdowns=('host',), flt=None, interval='day',
+         op='query', opts=None):
+    bds = []
+    for b in breakdowns:
+        if b == 'latq':
+            bds.append({'name': 'latency', 'field': 'latency',
+                        'aggr': 'quantize'})
+        else:
+            bds.append({'name': b, 'field': b})
+    qc = {'breakdowns': bds}
+    if flt is not None:
+        qc['filter'] = flt
+    doc = {'op': op, 'ds': ds, 'config': corpus['rc_path'],
+           'queryconfig': qc, 'opts': opts or {}}
+    if op == 'query':
+        doc['interval'] = interval
+    return doc
+
+
+# -- byte identity: remote == local ----------------------------------------
+
+def _cases(ds):
+    return [
+        ['query', '-b', 'host', ds],
+        ['query', '-b', 'host,latency[aggr=quantize]', '--counters',
+         ds],
+        ['query', '--points', '-b', 'operation', '-f',
+         '{"eq": ["req.method", "GET"]}', ds],
+        ['query', '--raw', '-b', 'host,latency[aggr=quantize]',
+         '-A', '2014-01-02', '-B', '2014-01-03', ds],
+        ['scan', '-b', 'operation', '--raw', ds],
+        ['scan', '-b', 'host,latency[aggr=quantize]', '--counters',
+         ds],
+        ['build', ds],
+    ]
+
+
+def test_remote_byte_identical(server, corpus):
+    """Every command shape: `--remote` responses (stdout, stderr, rc)
+    match the sequential local CLI byte for byte."""
+    sock = server.socket_path
+    for ds in corpus['dss']:
+        for case in _cases(ds):
+            expected = run_cli(case)
+            remote = run_cli(case[:1] + ['--remote', sock] + case[1:])
+            assert remote == expected, case
+
+
+def test_concurrent_soak_byte_identical(server, corpus):
+    """N client threads x mixed scan/index-query/build against both
+    index formats: every response byte-identical to the sequential
+    local runs, with coalescing observable via /stats."""
+    sock = server.socket_path
+    work = []
+    for ds in corpus['dss']:
+        for case in _cases(ds):
+            work.append((case, run_cli(case)))
+
+    errors = []
+    start = threading.Barrier(8)
+
+    def client(tid):
+        start.wait()
+        for rep in range(3):
+            for i, (case, expected) in enumerate(work):
+                if (i + rep + tid) % 3 == 0:
+                    continue     # vary the mix per thread
+                got = run_cli(case[:1] + ['--remote', sock] +
+                              case[1:])
+                if got != expected:
+                    errors.append((tid, case, got, expected))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[0]
+
+    st = mod_client.stats(sock)
+    assert st['requests']['requests'] > 0
+    # the soak reuses identical in-flight queries heavily: shared
+    # executions must have happened
+    assert st['requests']['coalesced'] > 0
+    assert st['requests']['errors'] == 0
+
+
+def test_coalescing_shares_one_execution(corpus, tmp_path,
+                                         monkeypatch):
+    """With the single execution slot held, identical concurrent
+    queries attach to ONE leader: /stats shows followers, and every
+    response is byte-identical."""
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=8)).start()
+    try:
+        holder = threading.Thread(
+            target=mod_client.request_bytes,
+            args=(sock, {'op': '_sleep', 'ms': 500}))
+        holder.start()
+        time.sleep(0.15)      # the sleeper owns the only slot
+
+        req = _req('ds_dnc', corpus)
+        results = []
+
+        def fire():
+            results.append(mod_client.request_bytes(sock, req))
+
+        threads = [threading.Thread(target=fire) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        holder.join()
+
+        assert len(set((rc, out, err)
+                       for rc, hd, out, err in results)) == 1
+        assert results[0][0] == 0
+        shared = [hd['stats']['coalesced']
+                  for rc, hd, out, err in results]
+        assert sum(1 for s in shared if s) == 3
+        st = mod_client.stats(sock)
+        assert st['requests']['coalesced'] >= 3
+        assert st['requests']['executions'] >= 1
+    finally:
+        srv.stop()
+
+
+# -- admission + deadline DNError paths ------------------------------------
+
+def test_queue_full_fast_429(corpus, tmp_path, monkeypatch):
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=0)).start()
+    try:
+        holder = threading.Thread(
+            target=mod_client.request_bytes,
+            args=(sock, {'op': '_sleep', 'ms': 800}))
+        holder.start()
+        time.sleep(0.2)
+        t0 = time.monotonic()
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, _req('ds_dnc', corpus))
+        dt = time.monotonic() - t0
+        holder.join()
+        assert rc == 1
+        assert err.startswith(b'dn: server busy:'), err
+        assert b'DN_SERVE_MAX_INFLIGHT=1' in err
+        assert dt < 0.5      # fast rejection, not a convoy
+        st = mod_client.stats(sock)
+        assert st['requests']['busy_rejected'] == 1
+    finally:
+        srv.stop()
+
+
+def test_request_deadline_dnerror(corpus, tmp_path, monkeypatch):
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock, conf=_conf(deadline_ms=150)).start()
+    try:
+        t0 = time.monotonic()
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, {'op': '_sleep', 'ms': 5000})
+        dt = time.monotonic() - t0
+        assert rc == 1
+        assert b'request deadline (150 ms) exceeded' in err
+        assert dt < 3.0
+        st = mod_client.stats(sock)
+        assert st['requests']['deadline_expired'] == 1
+    finally:
+        srv.stop()
+
+
+def test_deadline_timeout_frees_admission_slot(corpus, tmp_path,
+                                               monkeypatch):
+    """An abandoned (deadline-expired) execution must not pin its
+    admission slot: with ONE slot and no queue, a request right after
+    a timeout succeeds instead of BusyError-ing until restart."""
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=0,
+                   deadline_ms=200)).start()
+    try:
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, {'op': '_sleep', 'ms': 3000})
+        assert rc == 1 and b'deadline' in err
+        # the wedged sleep still runs on its abandoned thread, but
+        # its slot was freed — the next request executes
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, _req('ds_dnc', corpus))
+        assert rc == 0, err
+    finally:
+        srv.stop()
+
+
+def test_coalescer_abandon_retires_dead_execution():
+    """After a leader's deadline expires, abandon() wakes followers
+    with the deadline error and lets NEW identical requests recompute
+    instead of attaching to the dead execution forever."""
+    c = mod_admission.Coalescer(True)
+    started = threading.Event()
+    release = threading.Event()
+    lease = {}
+    leader_result = {}
+
+    def leader():
+        def compute():
+            started.set()
+            release.wait(10)
+            return 'stale'
+        leader_result['v'] = c.run('k', compute, lease=lease)
+
+    t = threading.Thread(target=leader)
+    t.start()
+    assert started.wait(5)
+
+    follower_err = {}
+
+    def follower():
+        try:
+            c.run('k', lambda: 'unused')
+        except mod_admission.DeadlineError as e:
+            follower_err['e'] = e
+
+    tf = threading.Thread(target=follower)
+    tf.start()
+    time.sleep(0.05)
+    c.abandon(lease['key'], lease['ex'])
+    tf.join(5)
+    assert 'e' in follower_err        # follower shares leader's fate
+    # a fresh arrival computes fresh (no dead-execution attachment)
+    v, shared = c.run('k', lambda: 'fresh')
+    assert v == 'fresh' and shared is False
+    release.set()
+    t.join(5)
+    # the abandoned leader completing later is harmless
+    assert leader_result['v'] == ('stale', False)
+
+
+def test_remote_rejects_execution_mode_flags(server, corpus):
+    for args in (['query', '--iq-threads', '2'],
+                 ['query', '--iq-stack', '0'],
+                 ['scan', '--parse', 'host'],
+                 ['build', '--build-threads', '2']):
+        rc, out, err = run_cli(
+            args[:1] + ['--remote', server.socket_path] + args[1:] +
+            ['ds_dnc'])
+        assert rc == 2, (args, err)
+        assert b'cannot be combined with "--remote"' in err, args
+
+
+def test_per_request_deadline_override(corpus, tmp_path,
+                                       monkeypatch):
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(socket_path=sock,
+                              conf=_conf(deadline_ms=0)).start()
+    try:
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, {'op': '_sleep', 'ms': 5000, 'deadline_ms': 100})
+        assert rc == 1 and b'deadline' in err
+    finally:
+        srv.stop()
+
+
+# -- fallback + error framing ----------------------------------------------
+
+def test_remote_unreachable_falls_back_local(corpus, tmp_path):
+    missing = str(tmp_path / 'nope.sock')
+    expected = run_cli(['query', '-b', 'host', 'ds_dnc'])
+    rc, out, err = run_cli(['query', '--remote', missing, '-b',
+                            'host', 'ds_dnc'])
+    assert rc == 0
+    assert out == expected[1]
+    assert b'unreachable' in err and b'falling back' in err
+
+
+def test_remote_fatal_error_framing(server, corpus):
+    """Server-side fatal errors come back with the CLI's exact
+    'dn: <message>' framing and exit code."""
+    expected = run_cli(['query', '-b', 'host', 'no_such_ds'])
+    remote = run_cli(['query', '--remote', server.socket_path, '-b',
+                      'host', 'no_such_ds'])
+    assert expected[0] == remote[0] == 1
+    assert remote[2] == expected[2]
+    assert b'unknown datasource' in remote[2]
+
+
+def test_remote_rejects_warnings_flag(server, corpus):
+    rc, out, err = run_cli(['scan', '--remote', server.socket_path,
+                            '--warnings', '-b', 'host', 'ds_dnc'])
+    assert rc == 2
+    assert b'"--warnings" cannot be combined with "--remote"' in err
+
+
+def test_unsupported_op(server):
+    rc, hd, out, err = mod_client.request_bytes(
+        server.socket_path, {'op': 'shrug'})
+    assert rc == 1 and b'unsupported request op' in err
+
+
+# -- request-scoped counters -----------------------------------------------
+
+def test_request_scope_isolates_and_merges():
+    mod_vpipe.reset_global_counters()
+    seen = {}
+    start = threading.Barrier(2)
+
+    def worker(name, n):
+        with mod_vpipe.request_scope() as sc:
+            start.wait()
+            for _ in range(n):
+                mod_vpipe.counter_bump('soak counter')
+            time.sleep(0.05)
+            seen[name] = dict(sc)
+
+    a = threading.Thread(target=worker, args=('a', 3))
+    b = threading.Thread(target=worker, args=('b', 7))
+    a.start()
+    b.start()
+    a.join()
+    b.join()
+    # each request saw exactly its own delta, never the other's
+    assert seen['a'] == {'soak counter': 3}
+    assert seen['b'] == {'soak counter': 7}
+    # and the global store holds the merged total
+    assert mod_vpipe.global_counters()['soak counter'] == 10
+    # no scope: straight to global (the single-process CLI path)
+    mod_vpipe.counter_bump('soak counter')
+    assert mod_vpipe.global_counters()['soak counter'] == 11
+
+
+def test_request_counters_in_response_header(server, corpus):
+    """Each response carries only ITS OWN hidden-counter deltas —
+    shard fan-out counters attribute per request even under the
+    concurrent soak."""
+    req = _req('ds_dnc', corpus)
+    rc, hd, out, err = mod_client.request_bytes(server.socket_path,
+                                                req)
+    assert rc == 0
+    counters = hd['stats']['counters']
+    assert counters.get('index shards queried', 0) > 0
+
+
+def test_request_counters_attribute_across_pool_threads(
+        server, corpus, monkeypatch):
+    """On the per-shard pool path (DN_IQ_STACK=0, DN_IQ_THREADS>0)
+    the shard handle cache is hit from ShardQueryExecutor worker
+    threads — which adopt the request's counter scope, so cache
+    telemetry still lands in the request's own header stats."""
+    monkeypatch.setenv('DN_IQ_STACK', '0')
+    monkeypatch.setenv('DN_IQ_THREADS', '2')
+    req = _req('ds_dnc', corpus,
+               breakdowns=('operation',),
+               flt={'eq': ['req.method', 'GET']})
+    mod_client.request_bytes(server.socket_path, req)  # warm
+    rc, hd, out, err = mod_client.request_bytes(server.socket_path,
+                                                req)
+    assert rc == 0, err
+    counters = hd['stats']['counters']
+    assert counters.get('index handle cache hits', 0) + \
+        counters.get('index handle cache misses', 0) > 0
+
+
+def test_writer_invalidation_hook(server, corpus):
+    """A build THROUGH the server fires the writer-invalidation hook
+    (whole-tree retire + counted in /stats) and later queries still
+    answer correctly."""
+    before = mod_client.stats(server.socket_path)['counters'].get(
+        'index writer invalidations', 0)
+    rc, hd, out, err = mod_client.request_bytes(
+        server.socket_path,
+        {'op': 'build', 'ds': 'ds_dnc',
+         'config': corpus['rc_path'], 'interval': 'day',
+         'opts': {}})
+    assert rc == 0 and err == b'indexes for "ds_dnc" built\n'
+    after = mod_client.stats(server.socket_path)['counters'].get(
+        'index writer invalidations', 0)
+    assert after > before
+    expected = run_cli(['query', '-b', 'host', 'ds_dnc'])
+    got = run_cli(['query', '--remote', server.socket_path, '-b',
+                   'host', 'ds_dnc'])
+    assert got == expected
+
+
+# -- lifecycle hygiene -----------------------------------------------------
+
+def test_stale_pidfile_and_orphan_socket_reclaim(tmp_path):
+    sock = str(tmp_path / 'stale.sock')
+    pidfile = sock + '.pid'
+    # an orphaned socket: bound once, never unlinked (a crash)
+    s = mod_socket.socket(mod_socket.AF_UNIX,
+                          mod_socket.SOCK_STREAM)
+    s.bind(sock)
+    s.close()
+    with open(pidfile, 'w') as f:
+        f.write('999999999\n')
+    notes = []
+    mod_lifecycle.claim(socket_path=sock, pidfile=pidfile,
+                        warn=notes.append)
+    assert any('stale pidfile' in m for m in notes)
+    assert any('orphaned socket' in m for m in notes)
+    assert not os.path.exists(sock)
+    with open(pidfile) as f:
+        assert int(f.read()) == os.getpid()
+    # a fresh server can now bind the reclaimed path
+    srv = mod_server.DnServer(socket_path=sock, conf=_conf(),
+                              pidfile=pidfile).start()
+    try:
+        assert mod_lifecycle.probe(socket_path=sock)
+    finally:
+        srv.stop()
+    assert not os.path.exists(sock)
+    assert not os.path.exists(pidfile)
+
+
+def test_claim_refuses_live_server(tmp_path):
+    sock = str(tmp_path / 'live.sock')
+    srv = mod_server.DnServer(socket_path=sock, conf=_conf()).start()
+    try:
+        with pytest.raises(DNError) as ei:
+            mod_lifecycle.claim(socket_path=sock)
+        assert 'already running' in str(ei.value)
+    finally:
+        srv.stop()
+
+
+def test_sigterm_drain_completes_inflight(tmp_path):
+    """The daemon: SIGTERM mid-request stops accepting, FINISHES the
+    in-flight request, unlinks the socket, and exits 0."""
+    sock = str(tmp_path / 'daemon.sock')
+    env = dict(os.environ, DN_SERVE_TEST_OPS='1')
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+         'serve', '--socket', sock],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 60
+        while not mod_lifecycle.probe(socket_path=sock):
+            assert proc.poll() is None, proc.stderr.read()
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+
+        result = {}
+
+        def inflight():
+            result['r'] = mod_client.request_bytes(
+                sock, {'op': '_sleep', 'ms': 1200}, timeout_s=30)
+
+        t = threading.Thread(target=inflight)
+        t.start()
+        time.sleep(0.3)                  # request is in flight
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=30)
+        assert 'r' in result, 'in-flight request was dropped'
+        assert result['r'][0] == 0       # it COMPLETED
+        assert proc.wait(timeout=30) == 0
+        assert not os.path.exists(sock)
+        assert not os.path.exists(sock + '.pid')
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+# -- dn serve --validate ---------------------------------------------------
+
+def test_serve_validate_ok(monkeypatch):
+    monkeypatch.setenv('DN_SERVE_MAX_INFLIGHT', '3')
+    monkeypatch.setenv('DN_SERVE_DEADLINE_MS', '2500')
+    rc, out, err = run_cli(['serve', '--validate', '--socket',
+                            '/tmp/never-bound.sock'])
+    assert rc == 0
+    assert out == (b'serve config ok: max_inflight=3 queue_depth=16 '
+                   b'deadline_ms=2500 coalesce=1 drain_s=30\n')
+
+
+def test_serve_validate_bad_knob_fails_fast(monkeypatch):
+    monkeypatch.setenv('DN_SERVE_MAX_INFLIGHT', 'lots')
+    rc, out, err = run_cli(['serve', '--validate', '--socket',
+                            '/tmp/never-bound.sock'])
+    assert rc == 1
+    assert err == (b'dn: DN_SERVE_MAX_INFLIGHT: expected an integer '
+                   b'>= 1, got "lots"\n')
+
+
+def test_serve_requires_exactly_one_endpoint():
+    rc, out, err = run_cli(['serve'])
+    assert rc == 2
+    assert b'exactly one of "--socket" and "--port"' in err
+    rc, out, err = run_cli(['serve', '--socket', '/tmp/x.sock',
+                            '--port', '123'])
+    assert rc == 2
+
+
+def test_serve_bad_port():
+    rc, out, err = run_cli(['serve', '--port', 'zzz'])
+    assert rc == 2
+    assert b'bad value for "port"' in err
+
+
+def test_tcp_endpoint_roundtrip(corpus):
+    srv = mod_server.DnServer(port=0, conf=_conf()).start()
+    try:
+        addr = '127.0.0.1:%d' % srv.bound_port
+        expected = run_cli(['query', '-b', 'host', 'ds_dnc'])
+        got = run_cli(['query', '--remote', addr, '-b', 'host',
+                       'ds_dnc'])
+        assert got == expected
+    finally:
+        srv.stop()
