@@ -5,7 +5,7 @@
 PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
-    bench-serve clean parity-matrix
+    bench-serve soak-faults clean parity-matrix
 
 all: native
 
@@ -21,7 +21,7 @@ check:
 	$(PYTHON) tools/checkstyle dragnet_tpu bin tests \
 	    tools/checkstyle tools/json_streamer tools/pathenum \
 	    tools/validate-schema tools/profile_device tools/mktestdata \
-	    bench.py __graft_entry__.py
+	    tools/soak_faults.py bench.py __graft_entry__.py
 
 bench: native
 	$(PYTHON) bench.py
@@ -47,6 +47,13 @@ bench-parse: native
 # coalescing, and /stats (device engagement, cache hit rates)
 bench-serve: native
 	$(PYTHON) bench.py --serve-only
+
+# the chaos soak: mixed scan/query/build under deterministic fault
+# injection (>= 500 faults across every DN_FAULTS site) plus
+# mid-flush SIGKILL crash drills — asserts zero torn shards and
+# byte-identical output vs a fault-free run (docs/robustness.md)
+soak-faults: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py
 
 # golden byte-parity under every engine (the strongest single seal:
 # host per-record, vectorized, forced device, auto router), then the

@@ -616,13 +616,20 @@ def _try_remote(ctx, opts, req):
             raise UsageError(
                 '"%s" cannot be combined with "--remote"' % flag)
     req['config'] = ctx['backend'].cbl_path
+    if req.get('op') == 'build':
+        # builds are not idempotent: the key lets the transport
+        # layer's retry loop re-send safely — the server replays the
+        # recorded response instead of double-writing (serve/client.py)
+        import uuid
+        req['idempotency'] = uuid.uuid4().hex
     from .serve import client as mod_serve_client
     try:
         return mod_serve_client.run_or_fallback(opts.remote, req)
     except DNError as e:
-        # post-commit transport failure (RemoteTransportError): the
-        # server already acted and bytes may already be on stdout, so
-        # neither retrying nor falling back locally is safe — report
+        # transport retries exhausted (RemoteRetryExhausted) or a
+        # post-commit failure (RemoteTransportError): the server may
+        # have acted and bytes may already be on stdout, so neither
+        # another retry nor a local fallback is safe — report
         fatal(e)
 
 
@@ -825,6 +832,15 @@ def cmd_serve(ctx, argv):
     conf = mod_config.serve_config()
     if isinstance(conf, DNError):
         fatal(conf)
+    # the retry and fault-injection knobs share the fail-fast
+    # contract: a malformed value is caught here (and by --validate),
+    # not at the first request that needs it
+    remote_conf = mod_config.remote_config()
+    if isinstance(remote_conf, DNError):
+        fatal(remote_conf)
+    faults_conf = mod_config.faults_config()
+    if isinstance(faults_conf, DNError):
+        fatal(faults_conf)
 
     port = None
     if opts.port is not None:
@@ -839,15 +855,27 @@ def cmd_serve(ctx, argv):
             'exactly one of "--socket" and "--port" is required')
 
     if getattr(opts, 'validate', None):
-        # dry mode: the DN_SERVE_* knobs and the endpoint arguments
-        # were just validated through the same paths the daemon uses;
-        # report the resolved configuration and exit without binding
+        # dry mode: the DN_SERVE_* / DN_REMOTE_* / DN_FAULTS knobs and
+        # the endpoint arguments were just validated through the same
+        # paths the daemon and client use; report the resolved
+        # configuration and exit without binding
         sys.stdout.write(
             'serve config ok: max_inflight=%d queue_depth=%d '
             'deadline_ms=%d coalesce=%d drain_s=%d\n'
             % (conf['max_inflight'], conf['queue_depth'],
                conf['deadline_ms'], 1 if conf['coalesce'] else 0,
                conf['drain_s']))
+        sys.stdout.write(
+            'remote config ok: retries=%d backoff_ms=%d '
+            'connect_timeout_s=%d\n'
+            % (remote_conf['retries'], remote_conf['backoff_ms'],
+               remote_conf['connect_timeout_s']))
+        sites = faults_conf['sites']
+        if sites:
+            sys.stdout.write(
+                'faults armed: %s\n' % ' '.join(
+                    '%s:%s:%g:%d' % (s, k, r, seed)
+                    for s, (k, r, seed) in sorted(sites.items())))
         return 0
 
     from .serve import server as mod_server

@@ -356,6 +356,99 @@ def serve_config(env=None):
     return rv
 
 
+# --- remote-client retry knobs (DN_REMOTE_*) --------------------------
+#
+# Same contract as the serve knobs: parsed and validated in one place
+# (serve/client.py consumes them per request; `dn serve --validate`
+# checks them up front).  Each entry: (env name, kind, default, min).
+
+_REMOTE_KNOBS = [
+    # transport retries AFTER the first attempt (pre-commit failures
+    # and retryable server rejections); 0 disables retrying
+    ('DN_REMOTE_RETRIES', 'int', 2, 0),
+    # exponential-backoff base; attempt k sleeps ~base * 2^(k-1) with
+    # +/-50% jitter
+    ('DN_REMOTE_BACKOFF_MS', 'int', 50, 1),
+    # connect() deadline per attempt (the overall request timeout,
+    # DN_SERVE_CLIENT_TIMEOUT_S, still governs the exchange)
+    ('DN_REMOTE_CONNECT_TIMEOUT_S', 'int', 5, 1),
+]
+
+
+def remote_config(env=None):
+    """The resolved DN_REMOTE_* knob dict (keys: retries, backoff_ms,
+    connect_timeout_s), or DNError on the first malformed value."""
+    if env is None:
+        env = os.environ
+    rv = {}
+    for name, kind, default, minimum in _REMOTE_KNOBS:
+        key = name[len('DN_REMOTE_'):].lower()
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        if value < minimum:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        rv[key] = value
+    return rv
+
+
+# --- fault-injection spec (DN_FAULTS) ---------------------------------
+
+def faults_config(env=None):
+    """Parse + validate DN_FAULTS=site:kind:rate[:seed],...  Returns
+    {'sites': {site: (kind, rate, seed)}} (empty when unset) or the
+    first violation as DNError — the same contract every other knob
+    follows, checked by `dn serve --validate` and raised at the first
+    armed injection seam otherwise (faults.fire)."""
+    if env is None:
+        env = os.environ
+    spec = env.get('DN_FAULTS', '')
+    sites = {}
+    if not spec:
+        return {'sites': sites}
+    from . import faults as mod_faults
+    for part in spec.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(':')
+        if len(fields) not in (3, 4):
+            return DNError('DN_FAULTS: expected site:kind:rate[:seed],'
+                           ' got "%s"' % part)
+        site, kind, rate = fields[0], fields[1], fields[2]
+        if site not in mod_faults.SITES:
+            return DNError('DN_FAULTS: unknown site "%s" (known: %s)'
+                           % (site, ', '.join(mod_faults.SITES)))
+        if kind not in mod_faults.KINDS:
+            return DNError('DN_FAULTS: unknown kind "%s" (known: %s)'
+                           % (kind, ', '.join(mod_faults.KINDS)))
+        try:
+            ratef = float(rate)
+        except ValueError:
+            ratef = -1.0
+        if not 0.0 < ratef <= 1.0:
+            return DNError('DN_FAULTS: rate must be in (0, 1], '
+                           'got "%s"' % rate)
+        seed = 0
+        if len(fields) == 4:
+            try:
+                seed = int(fields[3])
+            except ValueError:
+                return DNError('DN_FAULTS: seed must be an integer, '
+                               'got "%s"' % fields[3])
+        if site in sites:
+            return DNError('DN_FAULTS: site "%s" armed twice' % site)
+        sites[site] = (kind, ratef, seed)
+    return {'sites': sites}
+
+
 class ConfigBackendLocal(object):
     """JSON config file with atomic tmp+rename save."""
 

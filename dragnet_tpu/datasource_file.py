@@ -979,6 +979,12 @@ class DatasourceFile(object):
             raise params
         root, timeformat, after, before = params
 
+        # crash-recovery sweep (TTL-throttled): a builder that died
+        # mid-publish must be rolled forward/back before this reader
+        # walks the tree (index_journal)
+        from . import index_journal as mod_journal
+        mod_journal.maybe_sweep(self.ds_indexpath)
+
         if before is None and pipeline.warn_func is None:
             # unbounded query over a flat index tree: the whole-tree
             # walk (one stat per shard) is memoized on the directory's
@@ -988,6 +994,11 @@ class DatasourceFile(object):
             files = self._find(root, timeformat, after, before, pipeline)
         if isinstance(files, DNError):
             raise files
+        # never open build machinery as a shard: journals, in-flight
+        # tmps (a concurrent builder's), and the quarantine directory
+        # stay out of the shard set
+        files = [(p, st) for p, st in files
+                 if not mod_journal.is_index_litter(p)]
 
         if dry_run:
             return ScanResult(pipeline,

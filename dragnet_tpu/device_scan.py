@@ -704,7 +704,9 @@ class DeviceScan(VectorScan):
         """Pure backend-eligibility check (initializes the backend, no
         scan-state mutation) — the single definition shared by the
         synchronous (forced) and background (auto) probes."""
-        ok = backend_ready()
+        from . import faults as mod_faults
+        mod_faults.fire('device.probe')    # chaos: probe failure ->
+        ok = backend_ready()               # clean host fallback
         if ok and self.REQUIRE_ACCELERATOR:
             from .ops import is_accelerator
             ok = is_accelerator()

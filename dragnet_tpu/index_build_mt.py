@@ -255,26 +255,86 @@ def _breakdown_positions(decomp_names, metric):
     return sel
 
 
-def _bucket_task(metrics, indexpath, config, parts, catalog):
-    """One bucket's whole write lifecycle, run by exactly one worker:
-    create the sink, bulk-append every metric's rows, flush (tmp+rename
-    atomicity lives in the sink), then invalidate the reader cache.
+def _prepare_task(metrics, indexpath, config, parts, catalog, suffix,
+                  out, i):
+    """One bucket's PREPARE, run by exactly one worker: create the
+    sink (per-build tmp suffix), bulk-append every metric's rows, and
+    write the complete tmp file — no rename yet; the journaled commit
+    phase (_publish_buckets) renames every prepared shard at once.
     `catalog` is the shared metric_catalog_rows result — identical in
-    every shard, serialized once per build instead of once per shard."""
-    from .index_query_mt import shard_cache_invalidate
-
+    every shard, serialized once per build instead of once per
+    shard."""
     def task():
         sink = make_index_sink(metrics, indexpath, config=config,
-                               catalog=catalog)
+                               catalog=catalog, tmp_suffix=suffix)
+        out[i] = sink
         try:
             for mi, keycols, values in parts:
                 sink.write_rows(mi, keycols, values)
-            sink.flush()
+            sink.prepare()
         except BaseException:
-            sink.abort()      # crash hygiene: no <name>.<pid> litter
+            sink.abort()      # crash hygiene: no tmp litter
+            out[i] = None
             raise
-        shard_cache_invalidate(indexpath)
     return task
+
+
+def publish_prepared(journal, sinks, paths):
+    """The commit phase shared by the block and streaming publishers:
+    land the journal's commit record (THE commit point), rename every
+    prepared tmp into place in bucket order, retire the journal.
+
+    Rename failures do NOT discard state: the commit record makes the
+    tmps durable publish intent, so every remaining tmp and the
+    journal stay on disk and the loop keeps renaming what it can —
+    the recovery sweep finishes the publish once this process dies,
+    or the next build over the tree supersedes the intent
+    (index_journal.cleanup_own_stale).  The earliest bucket-order
+    error still re-raises so the caller reports the failure."""
+    from .index_query_mt import shard_cache_invalidate
+    journal.record_commit(paths)
+    err = None
+    for sink, path in zip(sinks, paths):
+        try:
+            sink.commit(discard_on_error=False)
+            shard_cache_invalidate(path)
+        except BaseException as e:
+            if err is None:
+                err = e
+    if err is not None:
+        raise err
+    journal.retire()
+
+
+def _publish_buckets(metrics, indexroot, buckets, catalog, nworkers):
+    """Two-phase publish of one build's whole shard set.  `buckets` is
+    [(indexpath, config, parts)] in bucket order.  Phase 1 prepares
+    every shard's complete tmp on the flush pool; phase 2 is
+    publish_prepared.  A crash at any instant leaves a tree the
+    recovery sweep lands on exactly pre-build (no commit record: tmps
+    quarantined) or exactly post-build (commit record: renames
+    finished) — never a mix.  Prepare-phase errors keep the seed
+    contract: the earliest bucket-order error re-raises and no tmp
+    litter survives."""
+    from . import index_journal as mod_journal
+
+    mod_journal.sweep_index_tree(indexroot)
+    mod_journal.cleanup_own_stale(indexroot)
+    journal = mod_journal.BuildJournal(indexroot)
+    paths = [p for p, config, parts in buckets]
+    sinks = [None] * len(buckets)
+    tasks = [_prepare_task(metrics, path, config, parts, catalog,
+                           journal.tmp_suffix, sinks, i)
+             for i, (path, config, parts) in enumerate(buckets)]
+    try:
+        run_flush_tasks(tasks, nworkers)
+    except BaseException:
+        for sink in sinks:
+            if sink is not None:
+                sink.abort()
+        raise
+    publish_prepared(journal, sinks, paths)
+    _notify_index_written(indexroot, paths)
 
 
 def write_index_blocks(metrics, interval, indexroot, blocks,
@@ -284,7 +344,8 @@ def write_index_blocks(metrics, interval, indexroot, blocks,
     triple per metric — Aggregator.point_rows output plus its decomp
     names — in metric order.  Behaviorally identical to the retired
     per-point loop (same files, same bytes, same dn_start config) for
-    any worker count."""
+    any worker count; the shard set publishes through the crash-safe
+    journal (_publish_buckets)."""
     catalog = metric_catalog_rows(metrics)
     if interval == 'all':
         parts = []
@@ -292,10 +353,8 @@ def write_index_blocks(metrics, interval, indexroot, blocks,
             sel = _breakdown_positions(names, metrics[mi])
             parts.append((mi, [cols[p] for p in sel], weights))
         allpath = os.path.join(indexroot, 'all')
-        run_flush_tasks(
-            [_bucket_task(metrics, allpath, None, parts, catalog)],
-            nworkers)
-        _notify_index_written(indexroot, [allpath])
+        _publish_buckets(metrics, indexroot,
+                         [(allpath, None, parts)], catalog, nworkers)
         return
 
     span = interval_span(interval)
@@ -331,17 +390,13 @@ def write_index_blocks(metrics, interval, indexroot, blocks,
                  [[col[i] for i in idxs] for col in selcols],
                  [weights[i] for i in idxs]))
 
-    tasks = []
-    paths = []
+    ordered = []
     for bucket_s in sorted(buckets):
         indexpath = os.path.join(
             root, bucket_label(bucket_s, interval) + '.sqlite')
-        paths.append(indexpath)
-        tasks.append(_bucket_task(metrics, indexpath,
-                                  {'dn_start': bucket_s},
-                                  buckets[bucket_s], catalog))
-    run_flush_tasks(tasks, nworkers)
-    _notify_index_written(indexroot, paths)
+        ordered.append((indexpath, {'dn_start': bucket_s},
+                        buckets[bucket_s]))
+    _publish_buckets(metrics, indexroot, ordered, catalog, nworkers)
 
 
 # -- streaming entry: tagged point chunks -> sharded index files -----------
@@ -360,9 +415,15 @@ class StreamingIndexWriter(object):
     one worker; access is serialized by the task structure."""
 
     def __init__(self, metrics, interval, indexroot):
+        from . import index_journal as mod_journal
         self.metrics = metrics
         self.interval = interval
         self.indexroot = indexroot
+        # every sink writes tmps under this build's id; finish()
+        # publishes the whole set through the commit journal
+        mod_journal.sweep_index_tree(indexroot)
+        mod_journal.cleanup_own_stale(indexroot)
+        self._journal = mod_journal.BuildJournal(indexroot)
         self._catalog = metric_catalog_rows(metrics)
         self._names = [[b['b_name'] for b in m.m_breakdowns]
                        for m in metrics]
@@ -388,7 +449,8 @@ class StreamingIndexWriter(object):
                 config = {'dn_start': bucket_s}
             sink = make_index_sink(self.metrics, indexpath,
                                    config=config,
-                                   catalog=self._catalog)
+                                   catalog=self._catalog,
+                                   tmp_suffix=self._journal.tmp_suffix)
             self.sinks[bucket_s] = sink
             self.sinkpaths[bucket_s] = indexpath
         return sink
@@ -428,10 +490,12 @@ class StreamingIndexWriter(object):
             sink.abort()
 
     def finish(self, nworkers=None):
-        """Flush every bucket sink on the pool; on error the remaining
-        unflushed sinks are aborted (no tmp litter) and the earliest
-        bucket-order error re-raises."""
-        from .index_query_mt import shard_cache_invalidate
+        """Publish every bucket sink through the two-phase journal:
+        prepare each complete tmp on the pool, land the commit record,
+        then rename the whole set (see _publish_buckets — same crash
+        contract).  On a prepare error the remaining sinks are aborted
+        (no tmp litter) and the earliest bucket-order error
+        re-raises."""
         if self.span is None and not self.sinks:
             # an 'all' build always writes its (possibly empty) index
             # file — a zero-point stream must still produce a queryable
@@ -440,18 +504,17 @@ class StreamingIndexWriter(object):
         entries = list(self.sinks.items())
         done = [False] * len(entries)
 
-        def make_task(i, sink, path):
+        def make_task(i, sink):
             def task():
                 try:
-                    sink.flush()
+                    sink.prepare()
                 except BaseException:
                     sink.abort()
                     raise
-                shard_cache_invalidate(path)
                 done[i] = True
             return task
 
-        tasks = [make_task(i, sink, self.sinkpaths[key])
+        tasks = [make_task(i, sink)
                  for i, (key, sink) in enumerate(entries)]
         try:
             run_flush_tasks(tasks, nworkers)
@@ -460,5 +523,7 @@ class StreamingIndexWriter(object):
                 if not done[i]:
                     sink.abort()
             raise
-        _notify_index_written(self.indexroot,
-                              list(self.sinkpaths.values()))
+        paths = [self.sinkpaths[key] for key, sink in entries]
+        publish_prepared(self._journal, [s for k, s in entries],
+                         paths)
+        _notify_index_written(self.indexroot, paths)

@@ -221,12 +221,19 @@ class DncIndexSink(object):
     model.  Typed arrays are built at flush and the file appears
     atomically via tmp+rename."""
 
-    def __init__(self, metrics, filename, config=None, catalog=None):
+    def __init__(self, metrics, filename, config=None, catalog=None,
+                 tmp_suffix=None):
+        from . import faults as mod_faults
+        mod_faults.fire('sink.create')
         self.is_metrics = metrics
         self.is_dbfilename = filename
-        self.is_dbtmpfilename = filename + '.' + str(os.getpid())
+        self.is_dbtmpfilename = filename + '.' + \
+            (tmp_suffix or str(os.getpid()))
+        self._tmp_suffix = tmp_suffix
         self.is_config = dict(config or {})
         self.is_nwritten = 0
+        self._prepared = False
+        self._delegate = None     # _Incompatible fallback: IndexSink
         self._catalog = catalog
         self._names = [[b['b_name'] for b in m.m_breakdowns]
                        for m in metrics]
@@ -330,17 +337,25 @@ class DncIndexSink(object):
             tables.append((n, cols, vals, flags))
         return tables
 
-    def _flush_sqlite(self):
+    def _prepare_sqlite(self):
         """A value needs a storage class DNC cannot hold: replay the
         buffered columns into the SQLite engine instead (readers sniff
-        per file, so mixed trees work)."""
+        per file, so mixed trees work).  The delegate sink carries the
+        same tmp name, so two-phase callers and the recovery sweep see
+        one tmp whichever engine wrote it."""
         sink = IndexSink(self.is_metrics, self.is_dbfilename,
-                         config=self.is_config, catalog=self._catalog)
+                         config=self.is_config, catalog=self._catalog,
+                         tmp_suffix=self._tmp_suffix)
         for mi in range(len(self.is_metrics)):
             sink.write_rows(mi, self._keycols[mi], self._vals[mi])
-        sink.flush()
+        sink.prepare()
+        self._delegate = sink
 
-    def flush(self):
+    def prepare(self):
+        """Phase 1: the complete shard body lands in the tmp file (see
+        index_sink.IndexSink.prepare)."""
+        from . import faults as mod_faults
+        mod_faults.fire('sink.flush', torn_path=self.is_dbtmpfilename)
         try:
             tables = self._columnarize()
             configpairs = [('version', INDEX_VERSION)]
@@ -350,7 +365,8 @@ class DncIndexSink(object):
                 # as strings from the SQLite engine, so store strings
                 configpairs.append((k, _text_affinity(v)))
         except _Incompatible:
-            self._flush_sqlite()
+            self._prepare_sqlite()
+            self._prepared = True
             return
 
         lib = native_index.get_lib()
@@ -405,13 +421,33 @@ class DncIndexSink(object):
                 'tables': table_meta,
             }).encode()
             writer.finalize(footer)
-            os.rename(self.is_dbtmpfilename, self.is_dbfilename)
+            self._prepared = True
         except BaseException:
-            # crash hygiene: a failed serialization/rename must not
-            # leave `<name>.<pid>` behind
+            # crash hygiene: a failed serialization must not leave
+            # the tmp file behind
             writer.discard()
             self._discard_tmp()
             raise
+
+    def commit(self, discard_on_error=True):
+        """Phase 2: atomically rename the prepared tmp into place
+        (see index_sink.IndexSink.commit for both contracts)."""
+        from . import faults as mod_faults
+        if self._delegate is not None:
+            self._delegate.commit(discard_on_error=discard_on_error)
+            return
+        try:
+            mod_faults.fire('sink.rename')
+            os.rename(self.is_dbtmpfilename, self.is_dbfilename)
+        except BaseException:
+            if discard_on_error:
+                self._discard_tmp()
+            raise
+
+    def flush(self):
+        if not self._prepared:
+            self.prepare()
+        self.commit()
 
     def abort(self):
         """Discard the sink: drop the buffers and best-effort unlink

@@ -47,6 +47,7 @@ from datetime import datetime, timedelta, timezone
 
 from .errors import DNError
 from .aggr import Aggregator
+from . import faults as mod_faults
 from . import vpipe
 from .vpipe import counter_bump
 from .watchdog import LeakCheck
@@ -517,6 +518,7 @@ def query_shard_once(path, query):
     except DNError as e:
         raise DNError('index "%s"' % path, cause=e)
     try:
+        mod_faults.fire('iq.shard_read')
         sub = Aggregator(query)
         querier.run(query, aggr=sub)
         return list(sub.key_items())
@@ -530,6 +532,7 @@ def _query_shard_cached(path, query):
     handle = checkout_shard(path)
     ok = False
     try:
+        mod_faults.fire('iq.shard_read')
         sub = Aggregator(query)
         handle.querier.run(query, aggr=sub)
         items = list(sub.key_items())
@@ -572,6 +575,7 @@ def _load_shard_blocks_cached(path, query, memo):
     handle = checkout_shard(path)
     ok = False
     try:
+        mod_faults.fire('iq.shard_read')
         querier = handle.querier
         plan = memo.get(_catalog_sig(querier))
         if plan is None:

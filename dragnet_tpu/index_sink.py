@@ -7,11 +7,20 @@ JSON, params JSON), and one `dragnet_index_<i>` table per metric with
 escaped column names ('.'/'-' -> '_'), `integer` columns for aggregated
 fields and varchar(128) otherwise, plus a `value` column.
 
-Durability contract preserved: written to `<name>.<pid>`, fsync disabled
-(pragma synchronous=off), atomically renamed into place on flush
-(lib/index-sink.js:264-304) — a crash never leaves a torn index.  A
-*failed* flush (or abort()) best-effort unlinks the tmp file, so error
-paths leave the index directory clean too.
+Durability contract preserved: written to a tmp name (`<name>.<pid>`
+by default; journaled builds pass a per-build `tmp_suffix`), fsync
+disabled (pragma synchronous=off), atomically renamed into place on
+flush (lib/index-sink.js:264-304) — a crash never leaves a torn
+*committed* index.  A *failed* flush (or abort()) best-effort unlinks
+the tmp file, so error paths leave the index directory clean too.
+
+flush() is split into the two-phase primitives the build journal
+(index_journal.py) sequences across a whole shard set: prepare()
+writes and closes the complete tmp file, commit() atomically renames
+it into place.  flush() == prepare()+commit() for single-shard
+callers.  A SIGKILL between the phases leaves only a complete tmp
+plus the journal, which the recovery sweep rolls forward or back —
+a reader can only ever observe the pre-build or post-build tree.
 
 Both storage engines share one error contract (point_metric/point_row):
 a bad __dn_metric tag or a missing breakdown raises DNError — the
@@ -80,29 +89,38 @@ def metric_catalog_rows(metrics):
     return rows
 
 
-def make_index_sink(metrics, filename, config=None, catalog=None):
+def make_index_sink(metrics, filename, config=None, catalog=None,
+                    tmp_suffix=None):
     """Index writer for the configured format: DN_INDEX_FORMAT=dnc (the
     native columnar store, default) or sqlite (reference-compatible
     files).  Readers dispatch on file content, so either is queryable.
     `catalog` is an optional precomputed metric_catalog_rows(metrics) —
     a 365-shard build serializes the identical catalog into every
-    shard, so the caller computes it once."""
+    shard, so the caller computes it once.  `tmp_suffix` overrides the
+    default `<pid>` tmp-name suffix (journaled builds use their build
+    id so concurrent builds and the recovery sweep can tell tmps
+    apart)."""
     fmt = os.environ.get('DN_INDEX_FORMAT', 'dnc')
     if fmt == 'sqlite':
         return IndexSink(metrics, filename, config=config,
-                         catalog=catalog)
+                         catalog=catalog, tmp_suffix=tmp_suffix)
     from .index_dnc import DncIndexSink
     return DncIndexSink(metrics, filename, config=config,
-                        catalog=catalog)
+                        catalog=catalog, tmp_suffix=tmp_suffix)
 
 
 class IndexSink(object):
-    def __init__(self, metrics, filename, config=None, catalog=None):
+    def __init__(self, metrics, filename, config=None, catalog=None,
+                 tmp_suffix=None):
+        from . import faults as mod_faults
+        mod_faults.fire('sink.create')
         self.is_metrics = metrics
         self.is_dbfilename = filename
-        self.is_dbtmpfilename = filename + '.' + str(os.getpid())
+        self.is_dbtmpfilename = filename + '.' + \
+            (tmp_suffix or str(os.getpid()))
         self.is_config = dict(config or {})
         self.is_nwritten = 0
+        self._prepared = False
 
         dirname = os.path.dirname(self.is_dbtmpfilename)
         if dirname:
@@ -172,14 +190,42 @@ class IndexSink(object):
                                zip(*keycols, values))
         self.is_nwritten += len(values)
 
-    def flush(self):
+    def prepare(self):
+        """Phase 1: the complete shard body lands in the tmp file and
+        the connection closes.  On failure the tmp is discarded."""
+        from . import faults as mod_faults
         try:
+            # torn kind: the tmp already carries partial body bytes —
+            # truncate-and-crash models the mid-write power cut
+            mod_faults.fire('sink.flush',
+                            torn_path=self.is_dbtmpfilename)
             self.is_db.commit()
             self.is_db.close()
-            os.rename(self.is_dbtmpfilename, self.is_dbfilename)
+            self._prepared = True
         except BaseException:
             self._discard_tmp()
             raise
+
+    def commit(self, discard_on_error=True):
+        """Phase 2: atomically rename the prepared tmp into place.
+        (No torn kind here: past the commit record the tmp must stay
+        complete so the recovery roll-forward publishes whole bytes —
+        kill/error/delay still apply.)  Journaled publishers pass
+        discard_on_error=False: their commit record makes the tmp
+        recoverable state, not litter."""
+        from . import faults as mod_faults
+        try:
+            mod_faults.fire('sink.rename')
+            os.rename(self.is_dbtmpfilename, self.is_dbfilename)
+        except BaseException:
+            if discard_on_error:
+                self._discard_tmp()
+            raise
+
+    def flush(self):
+        if not self._prepared:
+            self.prepare()
+        self.commit()
 
     def abort(self):
         """Discard the sink: close the connection and best-effort

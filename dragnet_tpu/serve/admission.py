@@ -41,11 +41,20 @@ from ..errors import DNError
 
 
 class BusyError(DNError):
-    """Queue-full fast rejection (the 429 analog)."""
+    """Queue-full fast rejection (the 429 analog).  Retryable: the
+    client's backoff loop may try again."""
 
 
 class DeadlineError(DNError):
     """Per-request deadline expiry (the 504 analog)."""
+
+
+class DrainingError(DNError):
+    """The server is draining (SIGTERM/stop): queued-but-unadmitted
+    requests get this clean, retryable rejection instead of a
+    connection reset when the process exits.  A retrying client (or
+    the future scatter-gather router) re-sends to the replacement
+    server."""
 
 
 class Slot(object):
@@ -80,13 +89,25 @@ class Admission(object):
         self._cond = threading.Condition()
         self._inflight = 0
         self._queued = 0
+        self._draining = False
+
+    def shutdown(self):
+        """Begin draining: every queued waiter (and every future
+        acquire) raises DrainingError instead of waiting for a slot —
+        in-flight executions are unaffected and finish normally."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
 
     def acquire(self):
         """Take an execution slot, waiting in the bounded queue if
         needed.  Returns a Slot (release it exactly-or-more-than
-        once).  Raises BusyError immediately when the queue is
-        full."""
+        once).  Raises BusyError immediately when the queue is full,
+        DrainingError once shutdown() was called."""
         with self._cond:
+            if self._draining:
+                raise DrainingError('server draining: request not '
+                                    'admitted; retry another replica')
             if self._inflight < self.max_inflight:
                 self._inflight += 1
                 return Slot(self)
@@ -99,6 +120,10 @@ class Admission(object):
             self._queued += 1
             try:
                 while self._inflight >= self.max_inflight:
+                    if self._draining:
+                        raise DrainingError(
+                            'server draining: request not admitted; '
+                            'retry another replica')
                     self._cond.wait()
             finally:
                 self._queued -= 1

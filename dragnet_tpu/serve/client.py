@@ -1,6 +1,6 @@
 """The `--remote` thin client: ship a parsed request to a resident
-`dn serve`, stream the result bytes back verbatim, and fall back to
-local execution — with a warning — when the server is unreachable.
+`dn serve`, stream the result bytes back verbatim, and survive
+transport flaps with bounded, jittered retries.
 
 The client does ALL argument parsing locally (usage errors never
 travel), ships the parsed QueryConfig document plus output options,
@@ -9,28 +9,61 @@ streams untouched — so remote output is byte-identical to local
 output by construction, and `dn query --remote ... | sort` composes
 exactly like the local pipeline would.
 
-Fallback contract: local execution is only a safe substitute while
-the request has observably NOT run — so the fallback window closes
-the moment the response header arrives.  A transport failure after
-that (server killed mid-response) raises RemoteTransportError
-instead: the server may have already acted (a build!) and response
-bytes may already be on this process's stdout, so re-running locally
-would duplicate both.
+Retry policy lives HERE, at the transport seam (Diba's
+transport/execution separation: the engines never see a retry):
+
+* Failures BEFORE the response header — connect refused/timed out,
+  the request send cut short, the connection dying before the header
+  — are pre-commit: the server has not published a response.  These
+  retry up to DN_REMOTE_RETRIES times with exponential backoff
+  (DN_REMOTE_BACKOFF_MS base, +/-50% jitter) on top of a per-attempt
+  connect deadline (DN_REMOTE_CONNECT_TIMEOUT_S).  Queries and scans
+  are idempotent; builds carry a client-generated idempotency key so
+  a retried build whose first request actually ran replays the
+  recorded response instead of double-writing.
+* Responses the server marks `retryable` (busy, draining) retry the
+  same way — the request was never admitted.
+* Failures AFTER the header arrives are post-commit: response bytes
+  may already be on this process's stdout, so the only honest outcome
+  is RemoteTransportError — never a silent re-run.
+
+When every attempt fails, the classification decides the caller's
+move: RemoteUnreachable (no attempt ever reached a server — local
+fallback is safe and run_or_fallback takes it, with the attempt count
+in the warning) vs RemoteRetryExhausted (the server saw at least one
+request but never answered — reported as a clean retryable transport
+error with the attempt count, never a bare socket traceback, and
+never a local fallback that might double-run a build).
 """
 
 import json
 import os
+import random
 import socket
 import sys
+import time
 
 from ..errors import DNError
+from .. import faults as mod_faults
+from ..vpipe import counter_bump
 
 CHUNK = 1 << 16
 
 
 class RemoteTransportError(DNError):
     """The connection died AFTER the server committed a response —
-    too late to fall back to local execution."""
+    too late to retry or fall back to local execution."""
+
+
+class RemoteUnreachable(DNError):
+    """Every attempt failed at connect: no server ever saw the
+    request, so local fallback is safe (run_or_fallback takes it)."""
+
+
+class RemoteRetryExhausted(DNError):
+    """Pre-commit failures exhausted the retry budget, but at least
+    one attempt reached a server (the request may have been received):
+    reported, not silently re-run locally."""
 
 
 def parse_addr(value):
@@ -43,7 +76,26 @@ def parse_addr(value):
     return ('unix', value, None)
 
 
-def _connect(value, timeout_s):
+def retry_conf():
+    """The validated DN_REMOTE_* knobs (config.remote_config); a
+    malformed value raises its DNError here, before any socket is
+    touched."""
+    from .. import config as mod_config
+    conf = mod_config.remote_config()
+    if isinstance(conf, DNError):
+        raise conf
+    return conf
+
+
+def _backoff_s(conf, attempt):
+    """Exponential backoff with +/-50% jitter: attempt k (1-based)
+    sleeps ~base * 2^(k-1) before attempt k+1."""
+    base = conf['backoff_ms'] / 1000.0
+    return base * (1 << (attempt - 1)) * random.uniform(0.5, 1.5)
+
+
+def _connect(value, timeout_s, connect_timeout_s):
+    mod_faults.fire('client.connect')
     kind, a, b = parse_addr(value)
     if kind == 'tcp':
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -51,20 +103,33 @@ def _connect(value, timeout_s):
     else:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         addr = a
+    # the connect deadline is its own (tighter) knob: a dead host must
+    # fail fast so the retry/backoff loop — or the fallback — can act;
+    # the exchange keeps the caller's longer timeout
+    sock.settimeout(connect_timeout_s)
+    try:
+        sock.connect(addr)
+    except BaseException:
+        sock.close()
+        raise
     sock.settimeout(timeout_s)
-    sock.connect(addr)
     return sock
 
 
-def _open_request(remote, req, timeout_s):
+def _open_request(remote, req, timeout_s, conf, phase):
     """Connect, send one request line, read the response header.
     Everything in here is the pre-commit phase: failures raise plain
-    OSError/ValueError and falling back to local execution is safe.
-    Returns (header, response_file, sock)."""
-    sock = _connect(remote, timeout_s)
+    OSError/ValueError and retrying is safe.  `phase['phase']` tracks
+    how far the attempt got ('connect' -> 'exchange') so exhausted
+    retries classify correctly.  Returns (header, response_file,
+    sock)."""
+    sock = _connect(remote, timeout_s, conf['connect_timeout_s'])
+    phase['phase'] = 'exchange'
     try:
+        mod_faults.fire('client.send')
         sock.sendall(json.dumps(req).encode() + b'\n')
         f = sock.makefile('rb')
+        mod_faults.fire('client.recv')
         line = f.readline()
         if not line:
             raise OSError('server closed the connection before '
@@ -93,16 +158,57 @@ def _read_exact(f, size):
         left -= len(chunk)
 
 
-def _roundtrip(remote, req, timeout_s):
-    """One buffered request/response exchange: returns (header,
-    stdout_bytes, stderr_bytes)."""
-    header, f, sock = _open_request(remote, req, timeout_s)
-    try:
-        out = b''.join(_read_exact(f, header.get('nout', 0)))
-        err = b''.join(_read_exact(f, header.get('nerr', 0)))
-        return header, out, err
-    finally:
-        sock.close()
+def _default_timeout_s():
+    return float(os.environ.get('DN_SERVE_CLIENT_TIMEOUT_S', '3600'))
+
+
+def _exchange_with_retry(remote, req, timeout_s, on_header):
+    """The shared retry loop: attempt the request up to
+    1 + DN_REMOTE_RETRIES times, backing off between attempts on
+    pre-commit transport failures and retryable server rejections
+    (busy/draining).  On a kept response, returns
+    on_header(header, f) with the socket managed here.  Raises
+    RemoteUnreachable / RemoteRetryExhausted on exhaustion (see
+    module docstring) and RemoteTransportError from on_header's
+    post-commit reads."""
+    conf = retry_conf()
+    attempts = conf['retries'] + 1
+    last_err = None
+    reached_server = False
+    for attempt in range(1, attempts + 1):
+        phase = {'phase': 'connect'}
+        try:
+            header, f, sock = _open_request(remote, req, timeout_s,
+                                            conf, phase)
+        except (OSError, ValueError, mod_faults.FaultInjected) as e:
+            last_err = e
+            if phase['phase'] != 'connect':
+                reached_server = True
+            if attempt < attempts:
+                counter_bump('remote transport retries')
+                time.sleep(_backoff_s(conf, attempt))
+                continue
+            break
+        if header.get('retryable') and attempt < attempts:
+            # busy/draining: the request was never admitted — back
+            # off and try again (the last attempt keeps the server's
+            # error response so the user sees the real message)
+            sock.close()
+            counter_bump('remote retryable rejections')
+            time.sleep(_backoff_s(conf, attempt))
+            continue
+        try:
+            return on_header(header, f)
+        finally:
+            sock.close()
+    detail = getattr(last_err, 'strerror', None) or str(last_err)
+    if reached_server:
+        raise RemoteRetryExhausted(
+            'remote transport failed after %d attempt(s) '
+            '(retryable): %s' % (attempts, detail))
+    raise RemoteUnreachable(
+        'serve endpoint unreachable after %d attempt(s): %s'
+        % (attempts, detail))
 
 
 def _write_bytes(stream, data):
@@ -124,50 +230,80 @@ def _write_bytes(stream, data):
 
 
 def request(remote, req, timeout_s=None):
-    """Send one request and stream the response through this
-    process's stdout/stderr.  Returns the remote exit code.  Raises
-    OSError while falling back is still safe (pre-header), and
-    RemoteTransportError once it is not."""
+    """Send one request (with the retry/backoff armor) and stream the
+    response through this process's stdout/stderr.  Returns the
+    remote exit code.  Raises RemoteUnreachable while falling back is
+    still safe, RemoteRetryExhausted / RemoteTransportError when it
+    is not."""
     if timeout_s is None:
-        timeout_s = float(os.environ.get('DN_SERVE_CLIENT_TIMEOUT_S',
-                                         '3600'))
-    header, f, sock = _open_request(remote, req, timeout_s)
-    try:
+        timeout_s = _default_timeout_s()
+
+    def stream_through(header, f):
         for size, stream in ((header.get('nout', 0), sys.stdout),
                              (header.get('nerr', 0), sys.stderr)):
             for chunk in _read_exact(f, size):
                 _write_bytes(stream, chunk)
         return int(header.get('rc', 1))
+
+    return _exchange_with_retry(remote, req, timeout_s,
+                                stream_through)
+
+
+def request_bytes(remote, req, timeout_s=60.0, retry=False):
+    """request() for harnesses and probes: returns (rc, header,
+    stdout_bytes, stderr_bytes) instead of writing through the
+    process streams.  Probes default to a single attempt (a liveness
+    check must not mask a dead server behind retries); pass
+    retry=True for the armored path."""
+    def buffer_up(header, f):
+        out = b''.join(_read_exact(f, header.get('nout', 0)))
+        err = b''.join(_read_exact(f, header.get('nerr', 0)))
+        return int(header.get('rc', 1)), header, out, err
+
+    if retry:
+        return _exchange_with_retry(remote, req, timeout_s, buffer_up)
+    conf = retry_conf()
+    header, f, sock = _open_request(remote, req, timeout_s, conf,
+                                    {'phase': 'connect'})
+    try:
+        return buffer_up(header, f)
     finally:
         sock.close()
 
 
-def request_bytes(remote, req, timeout_s=60.0):
-    """request() for harnesses: returns (rc, header, stdout_bytes,
-    stderr_bytes) instead of writing through the process streams."""
-    header, out, err = _roundtrip(remote, req, timeout_s)
-    return int(header.get('rc', 1)), header, out, err
-
-
 def run_or_fallback(remote, req):
-    """request() with the unreachable-server contract: on a
-    PRE-COMMIT failure (connect/send/header) print the fallback
-    warning and return None so the caller runs the command locally.
-    Post-commit transport failures (RemoteTransportError) propagate —
-    the server already acted and bytes may already be on stdout."""
+    """request() with the unreachable-server contract: when NO
+    attempt ever reached a server (RemoteUnreachable), print the
+    fallback warning — with the attempt count — and return None so
+    the caller runs the command locally.  Once a server may have seen
+    the request (RemoteRetryExhausted) or already responded
+    (RemoteTransportError), the error propagates: re-running locally
+    could duplicate output or a build's side effects."""
     try:
         return request(remote, req)
-    except RemoteTransportError:
+    except (RemoteTransportError, RemoteRetryExhausted):
         raise
-    except (OSError, ValueError) as e:
+    except RemoteUnreachable as e:
         sys.stderr.write(
             'dn: warning: serve endpoint "%s" unreachable (%s); '
-            'falling back to local execution\n'
-            % (remote, getattr(e, 'strerror', None) or e))
+            'falling back to local execution\n' % (remote, e.message))
         return None
 
 
 def stats(remote, timeout_s=5.0):
     """Fetch and parse the server's /stats document (bench + tests)."""
-    header, out, err = _roundtrip(remote, {'op': 'stats'}, timeout_s)
+    rc, header, out, err = request_bytes(remote, {'op': 'stats'},
+                                         timeout_s=timeout_s)
     return json.loads(out.decode('utf-8'))
+
+
+def health(remote, timeout_s=5.0):
+    """One un-retried health probe: the parsed health document, or
+    the error string — what a scatter-gather router polls to pick
+    live replicas."""
+    try:
+        rc, header, out, err = request_bytes(
+            remote, {'op': 'health'}, timeout_s=timeout_s)
+        return json.loads(out.decode('utf-8'))
+    except (OSError, ValueError, DNError) as e:
+        return {'ok': False, 'error': str(e)}

@@ -46,6 +46,7 @@ import time
 
 from .. import cli as mod_cli
 from .. import config as mod_config
+from .. import faults as mod_faults
 from .. import vpipe as mod_vpipe
 from .. import index_query_mt as mod_iqmt
 from .. import log as mod_log
@@ -236,6 +237,7 @@ class DnServer(object):
         self.coalescer = mod_admission.Coalescer(conf['coalesce'])
         self.log = mod_log.get('serve')
         self.running = False
+        self.draining = False
         self._listener = None
         self._stop = threading.Event()
         self._drained = threading.Event()
@@ -243,7 +245,14 @@ class DnServer(object):
         self._workers_lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._counters = {'requests': 0, 'errors': 0,
-                          'busy_rejected': 0, 'deadline_expired': 0}
+                          'busy_rejected': 0, 'deadline_expired': 0,
+                          'draining_rejected': 0,
+                          'build_idem_replays': 0}
+        # build idempotency: key -> {'done': Event, 'result': tuple}.
+        # A retried `dn build --remote` (same client-generated key)
+        # replays the recorded response instead of double-writing.
+        self._idem_lock = threading.Lock()
+        self._idem = {}
         self._by_op = {}
         self._t0 = time.time()
         self._hook = None
@@ -312,6 +321,11 @@ class DnServer(object):
         return self
 
     def request_stop(self):
+        # queued-but-unadmitted requests wake NOW with the clean,
+        # retryable DrainingError instead of dying with the listener;
+        # admitted executions finish inside the drain grace
+        self.draining = True
+        self.admission.shutdown()
         self._stop.set()
 
     def stop(self, wait=True):
@@ -370,6 +384,7 @@ class DnServer(object):
             'uptime_s': round(time.time() - self._t0, 3),
             'socket': self.socket_path,
             'port': self.bound_port,
+            'draining': self.draining,
             'requests': requests,
             'inflight': self.admission.depth(),
             'caches': {
@@ -382,6 +397,14 @@ class DnServer(object):
                 'signals': {k: counters.get(k, 0)
                             for k in _DEVICE_SIGNALS},
             },
+            # chaos/recovery observability: per-site injection
+            # telemetry (empty unless DN_FAULTS armed) and the
+            # crash-recovery counters (index_journal)
+            'faults': mod_faults.stats(),
+            'recovery': {k: counters.get(k, 0)
+                         for k in ('index recovery rollbacks',
+                                   'index recovery rollforwards',
+                                   'index tmps quarantined')},
         }
         try:
             from ..device_scan import _audition_cache_file
@@ -393,9 +416,12 @@ class DnServer(object):
     # -- request handling -------------------------------------------------
 
     def _handle_conn(self, conn):
+        f = None
         try:
+            mod_faults.fire('serve.accept')
             conn.settimeout(60)
             f = conn.makefile('rb')
+            mod_faults.fire('serve.read')
             line = f.readline(MAX_REQUEST_BYTES)
             if not line:
                 return
@@ -410,9 +436,28 @@ class DnServer(object):
                 return
             rc, out, err, extra = self.execute(req)
             self._respond(conn, rc, out, err, extra)
+        except mod_faults.FaultInjected:
+            # injected accept/read/write fault: drop the connection —
+            # the client sees EOF/reset, exactly the failure its
+            # pre-commit retry loop exists for
+            pass
         except OSError:
             pass
         finally:
+            # deterministic teardown: close the request-side makefile
+            # FIRST (it holds a reference on the socket's fd —
+            # conn.close() alone only decrements, and a lingering fd
+            # would leave the peer blocked on a half-dead connection
+            # instead of seeing EOF), then shut the socket down hard
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:
@@ -422,7 +467,9 @@ class DnServer(object):
 
     def _respond(self, conn, rc, out, err, extra):
         header = {'ok': rc == 0, 'rc': rc, 'nout': len(out),
-                  'nerr': len(err), 'stats': extra}
+                  'nerr': len(err), 'stats': extra,
+                  'retryable': bool(extra.get('retryable'))}
+        mod_faults.fire('serve.write')
         conn.sendall(json.dumps(header, sort_keys=True).encode() +
                      b'\n' + out + err)
 
@@ -433,10 +480,22 @@ class DnServer(object):
         self._bump_op(op)
         if op == 'ping':
             return 0, b'', b'', {}
+        if op == 'health':
+            # the replica-probe op (scatter-gather routers, load
+            # balancers): tiny, never queued behind admission
+            body = json.dumps({
+                'ok': not self.draining, 'draining': self.draining,
+                'pid': os.getpid(),
+                'uptime_s': round(time.time() - self._t0, 3),
+                'inflight': self.admission.depth(),
+            }, sort_keys=True) + '\n'
+            return 0, body.encode(), b'', {}
         if op == 'stats':
             body = json.dumps(self.stats_doc(), sort_keys=True,
                               indent=2) + '\n'
             return 0, body.encode(), b'', {}
+        if op == 'build' and req.get('idempotency'):
+            return self._execute_idempotent(req['idempotency'], req)
         if op in ('scan', 'query', 'build') or \
                 (op == '_sleep' and
                  os.environ.get('DN_SERVE_TEST_OPS') == '1'):
@@ -446,13 +505,65 @@ class DnServer(object):
                 ('dn: unsupported request op: "%s"\n' % op).encode(),
                 {})
 
+    def _execute_idempotent(self, key, req):
+        """Builds are NOT idempotent, so a retried build must not run
+        twice: the first request with a given client-generated key is
+        the leader and executes; duplicates (the client's retry after
+        a transport failure, which may have cut the RESPONSE, not the
+        request) wait for and replay the leader's recorded response.
+        Retryable rejections (busy/draining) are not recorded — the
+        build never ran, so a retry must execute."""
+        with self._idem_lock:
+            ent = self._idem.get(key)
+            leader = ent is None
+            if leader:
+                ent = {'done': threading.Event(), 'result': None}
+                self._idem[key] = ent
+        if not leader:
+            if not ent['done'].wait(3600.0):
+                self._bump('errors')
+                return (1, b'',
+                        b'dn: idempotent build never completed\n', {})
+            self._bump('build_idem_replays')
+            rc, out, err, extra = ent['result']
+            return rc, out, err, dict(extra, idempotent_replay=True)
+        try:
+            result = self._execute_data(req)
+        except BaseException:
+            # the leader died without a recordable response: retire
+            # the key so a retry RE-EXECUTES (nothing committed), and
+            # wake any followers with a clean retryable rejection —
+            # a poisoned key must never strand its duplicates for the
+            # full follower wait
+            with self._idem_lock:
+                self._idem.pop(key, None)
+            ent['result'] = (1, b'',
+                             b'dn: build execution failed before a '
+                             b'response was recorded; retry\n',
+                             {'retryable': True})
+            ent['done'].set()
+            raise
+        ent['result'] = result
+        with self._idem_lock:
+            if result[3].get('retryable'):
+                self._idem.pop(key, None)
+            else:
+                # bound the table: drop oldest COMPLETED records
+                done = [k for k, e in self._idem.items()
+                        if e['done'].is_set()]
+                for k in done[:max(0, len(self._idem) - 128)]:
+                    self._idem.pop(k, None)
+        ent['done'].set()
+        return result
+
     def _execute_data(self, req):
         t0 = time.monotonic()
         deadline_ms = req.get('deadline_ms')
         if deadline_ms is None:
             deadline_ms = self.conf['deadline_ms']
         cap = _Capture()
-        flags = {'coalesced': False, 'busy': False, 'deadline': False}
+        flags = {'coalesced': False, 'busy': False, 'deadline': False,
+                 'draining': False}
         scope_out = {}
 
         def job():
@@ -464,6 +575,11 @@ class DnServer(object):
                     rc = self._run_data(req, flags)
                 except mod_admission.BusyError as e:
                     flags['busy'] = True
+                    sys.stderr.write('%s: %s\n'
+                                     % (mod_cli.ARG0, e.message))
+                    rc = 1
+                except mod_admission.DrainingError as e:
+                    flags['draining'] = True
                     sys.stderr.write('%s: %s\n'
                                      % (mod_cli.ARG0, e.message))
                     rc = 1
@@ -525,11 +641,17 @@ class DnServer(object):
             self._bump('busy_rejected')
         if flags['deadline']:
             self._bump('deadline_expired')
+        if flags['draining']:
+            self._bump('draining_rejected')
         extra = {
             'coalesced': flags['coalesced'],
             'elapsed_ms': round((time.monotonic() - t0) * 1000, 3),
             'counters': scope_out,
         }
+        if flags['busy'] or flags['draining']:
+            # the request was never admitted: nothing ran, a retry is
+            # always safe — the client's backoff loop keys off this
+            extra['retryable'] = True
         return rc, out, err, extra
 
     def _tree_lock(self, ds, dsname):
@@ -595,6 +717,7 @@ class DnServer(object):
             result, shared = self.coalescer.run(key, compute,
                                                 lease=flags)
         except (mod_admission.BusyError,
+                mod_admission.DrainingError,
                 mod_admission.DeadlineError):
             raise
         except DNError as e:
@@ -646,6 +769,34 @@ class DnServer(object):
 
 # -- daemon entry (cmd_serve) -----------------------------------------------
 
+def sweep_configured_trees(warn=None):
+    """Crash-recovery sweep over every configured file datasource's
+    index tree — `dn serve` runs this at startup so a builder that
+    died while no server was resident is recovered before the first
+    request.  Returns {indexpath: sweep result} for trees that needed
+    work."""
+    from .. import index_journal as mod_journal
+    backend = mod_config.ConfigBackendLocal()
+    err, config = backend.load()
+    if err is not None:
+        return {}
+    acted = {}
+    for dsname, ds in config.datasource_list():
+        idx = (ds.get('ds_backend_config') or {}).get('indexPath')
+        if not idx:
+            continue
+        res = mod_journal.sweep_index_tree(idx)
+        if res['rollbacks'] or res['rollforwards'] or \
+                res['quarantined']:
+            acted[idx] = res
+            if warn is not None:
+                warn('recovered index tree "%s" (%d roll-forward(s), '
+                     '%d rollback(s), %d tmp(s) quarantined)'
+                     % (idx, res['rollforwards'], res['rollbacks'],
+                        res['quarantined']))
+    return acted
+
+
 def serve_main(socket_path=None, port=None, pidfile=None):
     """Run the daemon until SIGTERM/SIGINT, then drain.  Returns the
     process exit code."""
@@ -657,6 +808,7 @@ def serve_main(socket_path=None, port=None, pidfile=None):
     def warn(msg):
         sys.stderr.write('dn serve: %s\n' % msg)
 
+    sweep_configured_trees(warn=warn)
     mod_lifecycle.claim(socket_path=socket_path, port=port,
                         pidfile=pidfile, warn=warn)
     server = DnServer(socket_path=socket_path, port=port,

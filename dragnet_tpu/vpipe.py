@@ -159,3 +159,14 @@ class Pipeline(object):
             out = sys.stderr
         for s in self.stages:
             s.dump(out)
+        # chaos observability: per-site injection counts appear under
+        # DN_COUNTERS_ALL=1 (they only exist when DN_FAULTS armed a
+        # site that actually fired, so golden output is untouched)
+        import os
+        if os.environ.get('DN_COUNTERS_ALL') == '1':
+            from . import faults
+            for site, st in sorted(faults.stats().items()):
+                if st['fired']:
+                    out.write('%-18s %-13s%8d\n'
+                              % ('faults injected', site + ':',
+                                 st['fired']))

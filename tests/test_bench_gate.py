@@ -113,6 +113,52 @@ def test_parse_bench_extras_smoke(tmp_path, monkeypatch):
         assert out['parse_device_records_per_sec'] > 0
 
 
+# -- chaos observability: tier-1-safe smoke --------------------------------
+
+def test_injection_counters_visible_under_counters_all(tmp_path,
+                                                       monkeypatch):
+    """The bench-gate contract for the fault subsystem: with DN_FAULTS
+    armed, DN_COUNTERS_ALL=1 surfaces per-site injection counters in
+    the --counters dump, and faults.stats() reports the same firing."""
+    import io
+    from dragnet_tpu import faults as mod_faults
+    from dragnet_tpu import query as mod_query
+    from dragnet_tpu.datasource_file import DatasourceFile
+
+    datafile = str(tmp_path / 'd.log')
+    bench.gen_to_file(2000, datafile)
+    idx = str(tmp_path / 'idx')
+    ds = DatasourceFile({
+        'ds_backend': 'file', 'ds_format': 'json',
+        'ds_backend_config': {'path': datafile, 'indexPath': idx,
+                              'timeField': 'time'},
+        'ds_filter': None})
+    metric = mod_query.metric_deserialize({
+        'name': 'm', 'datasource': 'd', 'filter': None,
+        'breakdowns': [
+            {'name': 'timestamp', 'field': 'time', 'date': 'time',
+             'aggr': 'lquantize', 'step': 86400},
+            {'name': 'host', 'field': 'host'}]})
+    ds.build([metric], 'day')
+
+    monkeypatch.setenv('DN_FAULTS', 'iq.shard_read:delay:1.0')
+    monkeypatch.setenv('DN_FAULT_DELAY_MS', '1')
+    monkeypatch.setenv('DN_COUNTERS_ALL', '1')
+    mod_faults.reset()
+    try:
+        q = mod_query.query_load({'breakdowns': [
+            {'name': 'host', 'field': 'host'}]})
+        r = ds.query(q, 'day')
+        out = io.StringIO()
+        r.pipeline.dump_counters(out)
+        assert 'faults injected' in out.getvalue()
+        assert 'iq.shard_read:' in out.getvalue()
+        st = mod_faults.stats()['iq.shard_read']
+        assert st['fired'] > 0 and st['fired'] <= st['checked']
+    finally:
+        mod_faults.reset()
+
+
 # -- serve legs: tier-1-safe smoke -----------------------------------------
 
 def test_serve_bench_smoke(tmp_path, monkeypatch):

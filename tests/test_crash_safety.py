@@ -1,0 +1,230 @@
+"""Crash-safe index publishing (dragnet_tpu/index_journal.py): the
+recovery sweep's rollback/roll-forward/quarantine behavior, orphaned
+tmp hygiene after kill -9, and the headline guarantee — a `dn build`
+subprocess SIGKILLed mid-shard-flush (both DN_INDEX_FORMAT modes)
+leaves a tree whose query output byte-equals either the pre-build or
+the completed-build run, never a mix."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import cli                                # noqa: E402
+from dragnet_tpu import faults as mod_faults               # noqa: E402
+from dragnet_tpu import index_journal as mod_journal       # noqa: E402
+from dragnet_tpu.serve import server as mod_server         # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(args):
+    with mod_server.thread_stdio() as cap:
+        rc = cli.main(list(args))
+    out, err = cap.finish()
+    return rc, out, err
+
+
+def _dead_pid():
+    """A pid guaranteed dead: a child that already exited."""
+    proc = subprocess.Popen(['true'])
+    proc.wait()
+    return proc.pid
+
+
+# -- sweep unit behavior ---------------------------------------------------
+
+def test_sweep_quarantines_dead_builders_tmps(tmp_path):
+    idx = tmp_path / 'idx'
+    (idx / 'by_day').mkdir(parents=True)
+    pid = _dead_pid()
+    torn = idx / 'by_day' / ('2014-01-01.sqlite.%d' % pid)
+    torn.write_bytes(b'half a shard')
+    legacy = idx / 'by_day' / ('2014-01-02.sqlite.%d' % pid)
+    legacy.write_bytes(b'older writer litter')
+    keep = idx / 'by_day' / '2014-01-03.sqlite'
+    keep.write_bytes(b'a committed shard')
+
+    res = mod_journal.sweep_index_tree(str(idx))
+    assert res['quarantined'] == 2
+    assert res['rollbacks'] == 1
+    assert not torn.exists() and not legacy.exists()
+    assert keep.exists()
+    qdir = idx / mod_journal.QUARANTINE_DIR
+    assert sorted(os.listdir(str(qdir))) == sorted(
+        [torn.name, legacy.name])
+
+
+def test_sweep_leaves_live_builders_tmps_alone(tmp_path):
+    idx = tmp_path / 'idx'
+    (idx / 'by_day').mkdir(parents=True)
+    mine = idx / 'by_day' / ('2014-01-01.sqlite.%d.7' % os.getpid())
+    mine.write_bytes(b'in-flight')
+    res = mod_journal.sweep_index_tree(str(idx))
+    assert res['quarantined'] == 0
+    assert mine.exists()
+
+
+def test_sweep_rolls_forward_committed_journal(tmp_path):
+    idx = tmp_path / 'idx'
+    (idx / 'by_day').mkdir(parents=True)
+    pid = _dead_pid()
+    final = idx / 'by_day' / '2014-01-01.sqlite'
+    tmp = idx / 'by_day' / ('2014-01-01.sqlite.%d.1' % pid)
+    tmp.write_bytes(b'complete shard bytes')
+    already = idx / 'by_day' / '2014-01-02.sqlite'
+    already.write_bytes(b'renamed before the crash')
+    jpath = idx / (mod_journal.JOURNAL_PREFIX + '%d.1.json' % pid)
+    jpath.write_text(json.dumps({
+        'pid': pid, 'build_id': '%d.1' % pid, 'state': 'commit',
+        'entries': [
+            [str(tmp), str(final)],
+            [str(already) + '.%d.1' % pid, str(already)]]}))
+
+    res = mod_journal.sweep_index_tree(str(idx))
+    assert res['rollforwards'] == 1
+    assert final.read_bytes() == b'complete shard bytes'
+    assert already.read_bytes() == b'renamed before the crash'
+    assert not tmp.exists() and not jpath.exists()
+
+
+def test_sweep_quarantines_torn_journal_record(tmp_path):
+    idx = tmp_path / 'idx'
+    idx.mkdir()
+    pid = _dead_pid()
+    half = idx / (mod_journal.JOURNAL_PREFIX + '%d.1.json.tmp' % pid)
+    half.write_text('{"pid": %d, "state": "comm' % pid)
+    mod_journal.sweep_index_tree(str(idx))
+    assert not half.exists()
+    assert half.name in os.listdir(
+        str(idx / mod_journal.QUARANTINE_DIR))
+
+
+def test_litter_filter():
+    assert mod_journal.is_index_litter('2014-01-01.sqlite.123')
+    assert mod_journal.is_index_litter('2014-01-01.sqlite.123.9')
+    assert mod_journal.is_index_litter('all.123')
+    assert mod_journal.is_index_litter(
+        mod_journal.JOURNAL_PREFIX + '123.1.json')
+    assert mod_journal.is_index_litter(mod_journal.QUARANTINE_DIR)
+    assert not mod_journal.is_index_litter('2014-01-01.sqlite')
+    assert not mod_journal.is_index_litter('all')
+
+
+def test_query_ignores_litter_and_sweeps(tmp_path, monkeypatch):
+    """A reader over a tree with crash litter: the sweep runs on tree
+    open, the litter never opens as a shard, and output matches the
+    clean tree's byte for byte."""
+    corpus = _corpus(tmp_path, monkeypatch)
+    idx = corpus['idx']['dnc']
+    rc0, out0, err0 = run_cli(['query', '-b', 'host', 'ds_dnc'])
+    assert rc0 == 0
+    pid = _dead_pid()
+    litter = os.path.join(idx, 'by_day', '2014-01-01.sqlite.%d' % pid)
+    with open(litter, 'wb') as f:
+        f.write(b'torn')
+    mod_journal.reset_sweep_memo()
+    assert run_cli(['query', '-b', 'host', 'ds_dnc']) == \
+        (rc0, out0, err0)
+    assert not os.path.exists(litter)
+
+
+# -- kill -9 mid-build drills ----------------------------------------------
+
+def _gen(path, n, start=0):
+    import datetime
+    t0 = 1388534400
+    with open(path, 'a' if start else 'w') as f:
+        for i in range(start, start + n):
+            ts = datetime.datetime.utcfromtimestamp(
+                t0 + (i * 997) % (4 * 86400)).strftime(
+                    '%Y-%m-%dT%H:%M:%S.000Z')
+            f.write(json.dumps({
+                'time': ts, 'host': 'h%d' % (i % 3),
+                'latency': (i * 7) % 100}) + '\n')
+
+
+def _corpus(tmp_path, monkeypatch):
+    datafile = str(tmp_path / 'data.log')
+    _gen(datafile, 500)
+    rc_path = str(tmp_path / 'rc.json')
+    monkeypatch.setenv('DRAGNET_CONFIG', rc_path)
+    ctx = {'datafile': datafile, 'rc_path': rc_path, 'idx': {}}
+    for fmt in ('dnc', 'sqlite'):
+        ds = 'ds_' + fmt
+        idx = str(tmp_path / ('idx_' + fmt))
+        assert run_cli(['datasource-add', '--path', datafile,
+                        '--index-path', idx, '--time-field', 'time',
+                        ds])[0] == 0
+        assert run_cli(['metric-add', '-b',
+                        'timestamp[date,field=time,aggr=lquantize,'
+                        'step=86400],host', ds, 'm1'])[0] == 0
+        monkeypatch.setenv('DN_INDEX_FORMAT', fmt)
+        assert run_cli(['build', ds])[0] == 0
+        ctx['idx'][fmt] = idx
+    monkeypatch.delenv('DN_INDEX_FORMAT', raising=False)
+    return ctx
+
+
+def _no_litter(idx):
+    bad = []
+    for r, dirs, names in os.walk(idx):
+        if mod_journal.QUARANTINE_DIR in dirs:
+            dirs.remove(mod_journal.QUARANTINE_DIR)
+        bad.extend(os.path.join(r, n) for n in names
+                   if mod_journal.is_index_litter(n))
+    return bad
+
+
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_kill9_mid_flush_build_is_atomic(tmp_path, monkeypatch,
+                                         index_format):
+    """kill -9 a `dn build` subprocess mid-shard-flush (pre-commit)
+    and mid-rename (post-commit): after the recovery sweep, query
+    output byte-equals the pre-build run (rollback) or the
+    completed-build run (roll-forward) — never a mix, never a torn
+    shard."""
+    ctx = _corpus(tmp_path, monkeypatch)
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
+    ds = 'ds_' + index_format
+    idx = ctx['idx'][index_format]
+    pre = run_cli(['query', '-b', 'host', ds])
+    assert pre[0] == 0
+
+    # the killed build sees MORE data, so pre != post
+    _gen(ctx['datafile'], 250, start=500)
+
+    def killed_build(spec):
+        env = dict(os.environ, DN_FAULTS=spec, JAX_PLATFORMS='cpu',
+                   DN_INDEX_FORMAT=index_format)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+             'build', ds], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, timeout=240)
+        assert proc.returncode == -9, (proc.returncode, proc.stderr)
+
+    # (1) killed during prepare (no commit record): rollback
+    killed_build('sink.flush:torn:1.0' if index_format == 'sqlite'
+                 else 'sink.flush:kill:1.0')
+    mod_journal.reset_sweep_memo()
+    mod_faults.reset()
+    got = run_cli(['query', '-b', 'host', ds])
+    assert got == pre, 'rollback must restore the pre-build output'
+    assert _no_litter(idx) == []
+
+    # (2) killed mid-rename (commit record on disk): roll-forward
+    killed_build('sink.rename:kill:1.0')
+    mod_journal.reset_sweep_memo()
+    got = run_cli(['query', '-b', 'host', ds])
+    # the roll-forward published the whole new build: a clean rebuild
+    # over the same data must byte-match what we just read
+    assert run_cli(['build', ds])[0] == 0
+    post = run_cli(['query', '-b', 'host', ds])
+    assert got == post, 'roll-forward must complete the build'
+    assert got != pre
+    assert _no_litter(idx) == []

@@ -224,9 +224,40 @@ def test_failed_flush_leaves_no_tmp(tmp_path, sink_cls, monkeypatch):
 @pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
 def test_failed_build_leaves_index_dir_clean(tmp_path, index_format,
                                              monkeypatch):
-    """A mid-build failure (here: one shard's rename blowing up) leaves
-    no `<name>.<pid>` litter anywhere in the tree, and the error is the
-    same for sequential and parallel builds."""
+    """A PREPARE-phase failure (a sink blowing up before the commit
+    record) leaves no tmp litter anywhere in the tree, and the error
+    is the same for sequential and parallel builds."""
+    from dragnet_tpu import faults as mod_faults
+    monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
+    monkeypatch.setenv('DN_FAULTS', 'sink.flush:error:1.0')
+    datafile = str(tmp_path / 'data.log')
+    _make_data(datafile, n=1500)
+
+    messages = {}
+    for threads in ('0', '4'):
+        mod_faults.reset()
+        monkeypatch.setenv('DN_BUILD_THREADS', threads)
+        idx = str(tmp_path / ('idx' + threads))
+        with pytest.raises(DNError) as ei:
+            _ds(datafile, idx).build([_metric()], 'day')
+        messages[threads] = str(ei.value)
+        _assert_no_tmp(idx)
+    assert messages['0'] == messages['4']
+    monkeypatch.delenv('DN_FAULTS')
+    mod_faults.reset()
+
+
+@pytest.mark.parametrize('index_format', ['dnc', 'sqlite'])
+def test_failed_commit_is_recoverable_intent(tmp_path, index_format,
+                                             monkeypatch):
+    """A COMMIT-phase failure (one shard's rename blowing up AFTER the
+    journal commit record landed) must not tear the publish down: the
+    journal and the failed shard's complete tmp stay on disk as
+    recoverable intent, the error is deterministic across worker
+    counts, and the next build over the tree supersedes the stale
+    intent — ending byte-identical to a clean build with no litter
+    outside the quarantine."""
+    from dragnet_tpu import index_journal as mod_journal
     monkeypatch.setenv('DN_INDEX_FORMAT', index_format)
     datafile = str(tmp_path / 'data.log')
     _make_data(datafile, n=1500)
@@ -237,6 +268,10 @@ def test_failed_build_leaves_index_dir_clean(tmp_path, index_format,
             raise OSError('disk gone: %s' % os.path.basename(str(dst)))
         return real_rename(src, dst)
 
+    # the clean reference tree
+    idx_ref = str(tmp_path / 'idx_ref')
+    _ds(datafile, idx_ref).build([_metric()], 'day')
+
     messages = {}
     for threads in ('0', '4'):
         monkeypatch.setenv('DN_BUILD_THREADS', threads)
@@ -246,7 +281,23 @@ def test_failed_build_leaves_index_dir_clean(tmp_path, index_format,
             _ds(datafile, idx).build([_metric()], 'day')
         monkeypatch.setattr(os, 'rename', real_rename)
         messages[threads] = str(ei.value)
-        _assert_no_tmp(idx)
+        # the publish intent survives: the commit journal and the
+        # failed bucket's complete tmp are still there
+        journals = [n for n in os.listdir(idx)
+                    if n.startswith(mod_journal.JOURNAL_PREFIX)]
+        assert len(journals) == 1
+        assert any('2014-05-03.sqlite.' in n for n in
+                   os.listdir(os.path.join(idx, 'by_day')))
+        # the next build supersedes the stale intent and publishes
+        # a correct tree
+        _ds(datafile, idx).build([_metric()], 'day')
+        assert not any(n.startswith(mod_journal.JOURNAL_PREFIX)
+                       for n in os.listdir(idx))
+        _assert_no_tmp(os.path.join(idx, 'by_day'))
+        day = os.path.join(idx, 'by_day', '2014-05-03.sqlite')
+        ref = os.path.join(idx_ref, 'by_day', '2014-05-03.sqlite')
+        with open(day, 'rb') as f1, open(ref, 'rb') as f2:
+            assert f1.read() == f2.read()
     assert messages['0'] == messages['4']
 
 

@@ -515,6 +515,204 @@ def test_writer_invalidation_hook(server, corpus):
     assert got == expected
 
 
+# -- retry-hardened remote path --------------------------------------------
+
+def test_remote_dead_after_connect_reports_attempt_count(
+        corpus, tmp_path, monkeypatch):
+    """A server that accepts the connection but dies before the
+    response header: the client retries, then reports a clean
+    retryable transport error WITH the attempt count — no socket
+    traceback, and no local fallback that could double-run a
+    build."""
+    monkeypatch.setenv('DN_REMOTE_BACKOFF_MS', '1')
+    sock = str(tmp_path / 'dying.sock')
+    listener = mod_socket.socket(mod_socket.AF_UNIX,
+                                 mod_socket.SOCK_STREAM)
+    listener.bind(sock)
+    listener.listen(8)
+    stop = threading.Event()
+
+    def close_all():
+        listener.settimeout(0.1)
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except mod_socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.close()          # dies before any response header
+
+    t = threading.Thread(target=close_all, daemon=True)
+    t.start()
+    try:
+        for cmd in (['query', '-b', 'host'],
+                    ['scan', '-b', 'host'],
+                    ['build']):
+            rc, out, err = run_cli(
+                [cmd[0], '--remote', sock] + cmd[1:] + ['ds_dnc'])
+            text = err.decode()
+            assert rc == 1, (cmd, text)
+            assert 'dn: remote transport failed after 3 attempt(s)' \
+                in text, (cmd, text)
+            assert 'retryable' in text
+            assert 'Traceback' not in text
+            assert b'falling back' not in err     # never runs locally
+            assert out == b''
+    finally:
+        stop.set()
+        listener.close()
+
+
+def test_remote_unreachable_fallback_reports_attempts(
+        corpus, tmp_path, monkeypatch):
+    monkeypatch.setenv('DN_REMOTE_BACKOFF_MS', '1')
+    missing = str(tmp_path / 'nope.sock')
+    rc, out, err = run_cli(['query', '--remote', missing, '-b',
+                            'host', 'ds_dnc'])
+    assert rc == 0
+    assert b'unreachable after 3 attempt(s)' in err
+    assert b'falling back' in err
+
+
+def test_retry_recovers_from_transient_busy(corpus, tmp_path,
+                                            monkeypatch):
+    """A momentarily-saturated server (queue full -> retryable busy
+    rejection): the client's backoff loop lands the request once the
+    slot frees, byte-identical to local."""
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    monkeypatch.setenv('DN_REMOTE_RETRIES', '8')
+    monkeypatch.setenv('DN_REMOTE_BACKOFF_MS', '60')
+    sock = str(tmp_path / 'busy.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=0)).start()
+    try:
+        holder = threading.Thread(
+            target=mod_client.request_bytes,
+            args=(sock, {'op': '_sleep', 'ms': 400}))
+        holder.start()
+        time.sleep(0.1)           # the sleeper owns the only slot
+        expected = run_cli(['query', '-b', 'host', 'ds_dnc'])
+        got = run_cli(['query', '--remote', sock, '-b', 'host',
+                       'ds_dnc'])
+        holder.join()
+        assert got == expected
+        st = mod_client.stats(sock)
+        assert st['requests']['busy_rejected'] >= 1
+    finally:
+        srv.stop()
+
+
+def test_drain_rejects_queued_requests_cleanly(corpus, tmp_path,
+                                               monkeypatch):
+    """SIGTERM/stop mid-load: the in-flight request completes, the
+    QUEUED one gets the clean retryable 'draining' error instead of a
+    connection reset."""
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'drain.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=8)).start()
+    results = {}
+
+    def fire(name, req):
+        results[name] = mod_client.request_bytes(sock, req,
+                                                 timeout_s=30)
+
+    holder = threading.Thread(
+        target=fire, args=('held', {'op': '_sleep', 'ms': 800}))
+    holder.start()
+    time.sleep(0.2)                      # sleeper owns the only slot
+    queued = threading.Thread(
+        target=fire,
+        args=('queued', _req('ds_dnc', corpus)))
+    queued.start()
+    time.sleep(0.2)                      # queued request is waiting
+    srv.request_stop()
+    holder.join(timeout=30)
+    queued.join(timeout=30)
+    srv.stop()
+    assert results['held'][0] == 0       # in-flight COMPLETED
+    rc, hd, out, err = results['queued']
+    assert rc == 1
+    assert b'draining' in err
+    assert hd['retryable'] is True
+
+
+def test_health_op(server, corpus):
+    doc = mod_client.health(server.socket_path)
+    assert doc['ok'] is True
+    assert doc['draining'] is False
+    assert doc['pid'] == os.getpid()
+    assert 'inflight' in doc and 'uptime_s' in doc
+
+
+def test_health_on_dead_endpoint(tmp_path):
+    doc = mod_client.health(str(tmp_path / 'gone.sock'))
+    assert doc['ok'] is False
+    assert 'error' in doc
+
+
+def test_build_idempotency_key_replays_not_reruns(server, corpus):
+    """A retried build (same idempotency key) returns the RECORDED
+    response instead of running the build again."""
+    req = {'op': 'build', 'ds': 'ds_dnc',
+           'config': corpus['rc_path'], 'interval': 'day',
+           'opts': {}, 'idempotency': 'soak-key-1'}
+    first = mod_client.request_bytes(server.socket_path, dict(req))
+    assert first[0] == 0, first[3]
+    before = mod_client.stats(server.socket_path)
+    second = mod_client.request_bytes(server.socket_path, dict(req))
+    after = mod_client.stats(server.socket_path)
+    assert second[0] == 0
+    assert second[2] == first[2] and second[3] == first[3]
+    assert second[1]['stats'].get('idempotent_replay') is True
+    assert after['requests']['build_idem_replays'] == \
+        before['requests']['build_idem_replays'] + 1
+    # the replay did not execute a second build: the writer
+    # invalidation count is unchanged
+    assert after['counters'].get('index writer invalidations', 0) == \
+        before['counters'].get('index writer invalidations', 0)
+
+
+def test_injected_transport_faults_recovered_by_retry(
+        corpus, tmp_path, monkeypatch):
+    """The marquee chaos property: with error faults armed on the
+    client transport seams, the retry loop still lands every request
+    byte-identical to local execution."""
+    import dragnet_tpu.faults as mod_faults
+    sock = str(tmp_path / 'chaos.sock')
+    srv = mod_server.DnServer(socket_path=sock, conf=_conf()).start()
+    expected = run_cli(['query', '-b', 'host', 'ds_dnc'])
+    monkeypatch.setenv('DN_REMOTE_RETRIES', '6')
+    monkeypatch.setenv('DN_REMOTE_BACKOFF_MS', '1')
+    monkeypatch.setenv(
+        'DN_FAULTS',
+        'client.connect:error:0.3:5,client.send:error:0.2:6,'
+        'client.recv:error:0.3:7')
+    mod_faults.reset()
+    try:
+        for _ in range(6):
+            got = run_cli(['query', '--remote', sock, '-b', 'host',
+                           'ds_dnc'])
+            assert got == expected
+        assert mod_faults.total_fired() > 0
+    finally:
+        monkeypatch.delenv('DN_FAULTS')
+        mod_faults.reset()
+        srv.stop()
+
+
+def test_stats_reports_faults_and_recovery(server, corpus):
+    st = mod_client.stats(server.socket_path)
+    assert 'faults' in st
+    assert set(st['recovery']) == {'index recovery rollbacks',
+                                   'index recovery rollforwards',
+                                   'index tmps quarantined'}
+    assert st['draining'] is False
+
+
 # -- lifecycle hygiene -----------------------------------------------------
 
 def test_stale_pidfile_and_orphan_socket_reclaim(tmp_path):
@@ -600,11 +798,41 @@ def test_sigterm_drain_completes_inflight(tmp_path):
 def test_serve_validate_ok(monkeypatch):
     monkeypatch.setenv('DN_SERVE_MAX_INFLIGHT', '3')
     monkeypatch.setenv('DN_SERVE_DEADLINE_MS', '2500')
+    monkeypatch.delenv('DN_FAULTS', raising=False)
     rc, out, err = run_cli(['serve', '--validate', '--socket',
                             '/tmp/never-bound.sock'])
     assert rc == 0
     assert out == (b'serve config ok: max_inflight=3 queue_depth=16 '
-                   b'deadline_ms=2500 coalesce=1 drain_s=30\n')
+                   b'deadline_ms=2500 coalesce=1 drain_s=30\n'
+                   b'remote config ok: retries=2 backoff_ms=50 '
+                   b'connect_timeout_s=5\n')
+
+
+def test_serve_validate_reports_armed_faults(monkeypatch):
+    monkeypatch.setenv('DN_FAULTS',
+                       'sink.flush:error:0.5:7,client.recv:delay:1.0')
+    rc, out, err = run_cli(['serve', '--validate', '--socket',
+                            '/tmp/never-bound.sock'])
+    assert rc == 0
+    assert (b'faults armed: client.recv:delay:1:0 '
+            b'sink.flush:error:0.5:7\n') in out
+
+
+def test_serve_validate_rejects_bad_faults(monkeypatch):
+    monkeypatch.setenv('DN_FAULTS', 'nope.where:error:0.5')
+    rc, out, err = run_cli(['serve', '--validate', '--socket',
+                            '/tmp/never-bound.sock'])
+    assert rc == 1
+    assert b'DN_FAULTS: unknown site "nope.where"' in err
+
+
+def test_serve_validate_rejects_bad_remote_knob(monkeypatch):
+    monkeypatch.setenv('DN_REMOTE_RETRIES', 'many')
+    rc, out, err = run_cli(['serve', '--validate', '--socket',
+                            '/tmp/never-bound.sock'])
+    assert rc == 1
+    assert err == (b'dn: DN_REMOTE_RETRIES: expected an integer '
+                   b'>= 0, got "many"\n')
 
 
 def test_serve_validate_bad_knob_fails_fast(monkeypatch):

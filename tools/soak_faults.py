@@ -1,0 +1,473 @@
+#!/usr/bin/env python3
+"""Chaos soak: mixed scan/query/build traffic under deterministic
+fault injection (DN_FAULTS), plus mid-flush SIGKILL crash drills —
+asserting the repo's robustness contract end to end:
+
+* zero torn shards: after every round the index trees contain no
+  orphaned/torn tmp files outside the quarantine directory;
+* byte-identity: every operation that reports success returns output
+  byte-identical to a fault-free run, and every failure is a clean
+  `dn: ...` error (never a traceback);
+* crash atomicity: a `dn build` subprocess SIGKILLed mid-shard-flush
+  (or mid-commit) leaves a tree whose query output byte-equals either
+  the pre-build or the completed-build run — never a mix — once the
+  recovery sweep has run;
+* observability: injection/recovery counters appear in `dn serve`
+  /stats and under DN_COUNTERS_ALL=1.
+
+Run the full soak (>= 500 injected faults across all sites, both
+DN_INDEX_FORMAT modes) via `make soak-faults`; `--fast` runs the
+miniature tier-1 variant.  Exits non-zero on any violation.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from dragnet_tpu import cli                                # noqa: E402
+from dragnet_tpu import faults as mod_faults               # noqa: E402
+from dragnet_tpu import index_journal as mod_journal       # noqa: E402
+from dragnet_tpu import vpipe as mod_vpipe                 # noqa: E402
+from dragnet_tpu.serve import client as mod_client         # noqa: E402
+from dragnet_tpu.serve import server as mod_server         # noqa: E402
+
+FORMATS = ('dnc', 'sqlite')
+
+
+def run_cli(args, env=None):
+    """One in-process CLI run, stdout/stderr captured as bytes
+    through the serve layer's thread-stdio router."""
+    prior = {}
+    for k, v in (env or {}).items():
+        prior[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        with mod_server.thread_stdio() as cap:
+            rc = cli.main(list(args))
+        out, err = cap.finish()
+        return rc, out, err
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def gen_data(path, n, start=0, days=5):
+    """Deterministic newline-JSON over `days` days of 2014-01."""
+    import datetime
+    t0 = 1388534400  # 2014-01-01T00:00:00Z
+    mode = 'a' if start else 'w'
+    span = days * 86400
+    with open(path, mode) as f:
+        for i in range(start, start + n):
+            ts = datetime.datetime.utcfromtimestamp(
+                t0 + (i * 4999) % span).strftime(
+                    '%Y-%m-%dT%H:%M:%S.000Z')
+            f.write(json.dumps({
+                'time': ts,
+                'host': 'host%d' % (i % 4),
+                'operation': ('get', 'put', 'index')[i % 3],
+                'req': {'method': ('GET', 'PUT')[i % 2]},
+                'latency': (i * 7) % 230,
+            }, separators=(',', ':')) + '\n')
+
+
+def make_corpus(root, n=1200, days=5):
+    """Data + one datasource per index format, returning the context
+    the rounds use.  DRAGNET_CONFIG points at the corpus rc for the
+    whole soak."""
+    datafile = os.path.join(root, 'data.log')
+    gen_data(datafile, n, days=days)
+    rc_path = os.path.join(root, 'dragnetrc.json')
+    os.environ['DRAGNET_CONFIG'] = rc_path
+    ctx = {'root': root, 'rc_path': rc_path, 'datafile': datafile,
+           'n': n, 'days': days, 'ds': {}, 'idx': {}}
+    for fmt in FORMATS:
+        ds = 'ds_' + fmt
+        idx = os.path.join(root, 'idx_' + fmt)
+        rc, out, err = run_cli([
+            'datasource-add', '--path', datafile, '--index-path',
+            idx, '--time-field', 'time', ds])
+        assert rc == 0, err
+        rc, out, err = run_cli([
+            'metric-add', '-b',
+            'timestamp[date,field=time,aggr=lquantize,step=86400],'
+            'host,latency[aggr=quantize]', ds, 'm1'])
+        assert rc == 0, err
+        rc, out, err = run_cli([
+            'metric-add', '-b', 'operation', '-f',
+            '{"eq": ["req.method", "GET"]}', ds, 'm2'])
+        assert rc == 0, err
+        ctx['ds'][fmt] = ds
+        ctx['idx'][fmt] = idx
+    return ctx
+
+
+def build(ctx, fmt):
+    rc, out, err = run_cli(['build', ctx['ds'][fmt]],
+                           env={'DN_INDEX_FORMAT': fmt})
+    assert rc == 0, err
+    return rc, out, err
+
+
+def query_cases(ds):
+    return [
+        ['query', '-b', 'host', ds],
+        ['query', '-b', 'host,latency[aggr=quantize]', '--raw', ds],
+        ['query', '--points', '-b', 'operation', ds],
+        ['query', '-b', 'host', '-A', '2014-01-02', '-B',
+         '2014-01-04', ds],
+    ]
+
+
+def scan_cases(ds):
+    return [
+        ['scan', '-b', 'operation', '--raw', ds],
+        ['scan', '-b', 'host,latency[aggr=quantize]', ds],
+    ]
+
+
+def goldens(ctx):
+    """Fault-free reference bytes for every case x format."""
+    table = {}
+    for fmt in FORMATS:
+        ds = ctx['ds'][fmt]
+        for case in query_cases(ds) + scan_cases(ds):
+            table[(fmt, tuple(case))] = run_cli(
+                case, env={'DN_INDEX_FORMAT': fmt})
+    return table
+
+
+def tree_tmp_litter(idx):
+    """Torn/orphaned tmp files anywhere in the tree OUTSIDE the
+    quarantine directory — the soak's zero-torn-shards invariant."""
+    bad = []
+    for r, dirs, names in os.walk(idx):
+        if mod_journal.QUARANTINE_DIR in dirs:
+            dirs.remove(mod_journal.QUARANTINE_DIR)
+        for name in names:
+            if mod_journal.is_index_litter(name):
+                bad.append(os.path.join(r, name))
+    return bad
+
+
+class Soak(object):
+    def __init__(self, ctx, verbose=True):
+        self.ctx = ctx
+        self.golden = goldens(ctx)
+        self.violations = []
+        self.ops = 0
+        self.clean_errors = 0
+        self.verbose = verbose
+
+    def note(self, msg):
+        if self.verbose:
+            sys.stderr.write('soak: %s\n' % msg)
+
+    def violate(self, msg):
+        self.violations.append(msg)
+        sys.stderr.write('soak: VIOLATION: %s\n' % msg)
+
+    def check_result(self, fmt, case, got, remote=False):
+        """A faulted operation must be byte-identical to the golden
+        run, or a clean `dn: ...` failure."""
+        self.ops += 1
+        rc, out, err = got
+        gold = self.golden[(fmt, tuple(case))]
+        if rc == 0:
+            # warnings (e.g. device-probe fallback) may precede the
+            # output; stdout must match the golden exactly
+            if out != gold[1]:
+                self.violate('%s %s: success with divergent bytes'
+                             % (fmt, ' '.join(case)))
+            return
+        text = err.decode('utf-8', 'replace')
+        if 'Traceback' in text or 'dn:' not in text:
+            self.violate('%s %s: unclean failure: %r'
+                         % (fmt, ' '.join(case), text[-300:]))
+            return
+        self.clean_errors += 1
+
+    def check_trees(self, when):
+        """Zero-torn-shards invariant.  A commit-phase fault can leave
+        this process's own journal + tmps behind as RECOVERABLE
+        intent (by design); a clean superseding build retires it, so
+        the scan below only ever flags genuinely leaked state."""
+        mod_journal.reset_sweep_memo()
+        for fmt in FORMATS:
+            build(self.ctx, fmt)
+            mod_journal.sweep_index_tree(self.ctx['idx'][fmt])
+            litter = tree_tmp_litter(self.ctx['idx'][fmt])
+            if litter:
+                self.violate('%s: torn shards after %s: %s'
+                             % (fmt, when, litter))
+
+    # -- in-process fault rounds -------------------------------------
+
+    def local_rounds(self, spec, rounds, include_build=True,
+                     env=None):
+        # DN_FAULTS is armed ONCE for the whole block: the per-site
+        # PRNGs must keep drawing across operations (re-arming per op
+        # would re-seed them, collapsing every draw to the first)
+        prior = os.environ.get('DN_FAULTS')
+        os.environ['DN_FAULTS'] = spec
+        try:
+            for r in range(rounds):
+                for fmt in FORMATS:
+                    ds = self.ctx['ds'][fmt]
+                    cases = query_cases(ds) + scan_cases(ds)
+                    for case in cases:
+                        e = dict(env or {}, DN_INDEX_FORMAT=fmt)
+                        self.check_result(fmt, case,
+                                          run_cli(case, env=e))
+                    if include_build:
+                        e = dict(env or {}, DN_INDEX_FORMAT=fmt)
+                        rc, out, err = run_cli(['build', ds], env=e)
+                        self.ops += 1
+                        if rc != 0:
+                            text = err.decode('utf-8', 'replace')
+                            if 'Traceback' in text or \
+                                    'dn:' not in text:
+                                self.violate('%s build: unclean: %r'
+                                             % (fmt, text[-300:]))
+                            else:
+                                self.clean_errors += 1
+        finally:
+            if prior is None:
+                os.environ.pop('DN_FAULTS', None)
+            else:
+                os.environ['DN_FAULTS'] = prior
+        self.check_trees('local rounds [%s]' % spec)
+
+    # -- remote (serve) fault rounds ---------------------------------
+
+    def remote_rounds(self, spec, rounds, backoff_ms='5'):
+        sock = os.path.join(self.ctx['root'], 'soak.sock')
+        if os.path.exists(sock):
+            os.unlink(sock)
+        srv = mod_server.DnServer(
+            socket_path=sock,
+            conf={'max_inflight': 4, 'queue_depth': 16,
+                  'deadline_ms': 0, 'coalesce': True,
+                  'drain_s': 10}).start()
+        prior = os.environ.get('DN_FAULTS')
+        os.environ['DN_FAULTS'] = spec
+        env = {'DN_REMOTE_RETRIES': '4',
+               'DN_REMOTE_BACKOFF_MS': backoff_ms,
+               # bound the exchange so even a pathological drop costs
+               # the soak seconds, not the default interactive window
+               'DN_SERVE_CLIENT_TIMEOUT_S': '30'}
+        try:
+            for r in range(rounds):
+                for fmt in FORMATS:
+                    ds = self.ctx['ds'][fmt]
+                    for case in query_cases(ds) + scan_cases(ds):
+                        e = dict(env, DN_INDEX_FORMAT=fmt)
+                        got = run_cli(case[:1] + ['--remote', sock] +
+                                      case[1:], env=e)
+                        self.check_result(fmt, case, got)
+        finally:
+            if prior is None:
+                os.environ.pop('DN_FAULTS', None)
+            else:
+                os.environ['DN_FAULTS'] = prior
+            srv.stop()
+        self.check_trees('remote rounds [%s]' % spec)
+
+    # -- SIGKILL crash drills ----------------------------------------
+
+    def kill_rounds(self, specs, per_format=1):
+        """Subprocess `dn build` SIGKILLed mid-publish by each spec;
+        the recovered tree must answer queries byte-equal to either
+        the pre-build or the completed-build output."""
+        datafile = self.ctx['datafile']
+        n = self.ctx['n']
+        # extend the corpus so the killed build differs from the
+        # committed tree (otherwise pre == post and the assertion
+        # proves nothing)
+        gen_data(datafile, n // 2, start=n,
+                 days=self.ctx.get('days', 5))
+        self.ctx['n'] = n + n // 2
+        post = {}
+
+        def check_case(ds):
+            return ['query', '-b', 'host', ds]
+
+        pre = {fmt: self.golden[(fmt,
+                                 tuple(check_case(self.ctx['ds'][fmt])))]
+               for fmt in FORMATS}
+
+        for fmt in FORMATS:
+            ds = self.ctx['ds'][fmt]
+            for spec in specs:
+                for r in range(per_format):
+                    env = dict(os.environ, DN_INDEX_FORMAT=fmt,
+                               DN_FAULTS=spec, JAX_PLATFORMS='cpu')
+                    proc = subprocess.run(
+                        [sys.executable,
+                         os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+                         'build', ds],
+                        env=env, stdout=subprocess.PIPE,
+                        stderr=subprocess.PIPE, timeout=300)
+                    self.ops += 1
+                    if proc.returncode != -9:
+                        self.violate(
+                            '%s kill drill [%s]: expected SIGKILL, '
+                            'got rc=%s stderr=%r'
+                            % (fmt, spec, proc.returncode,
+                               proc.stderr[-200:]))
+                        continue
+                    self.note('killed build [%s] %s' % (spec, fmt))
+                    # recovery: the sweep runs on the query path
+                    mod_journal.reset_sweep_memo()
+                    got = run_cli(check_case(ds),
+                                  env={'DN_INDEX_FORMAT': fmt})
+                    if fmt not in post:
+                        # complete a clean build once to learn the
+                        # post-build bytes
+                        build(self.ctx, fmt)
+                        post[fmt] = run_cli(
+                            check_case(ds),
+                            env={'DN_INDEX_FORMAT': fmt})
+                        # rebuild happened AFTER `got` was measured;
+                        # got must match pre or post
+                    if got not in (pre[fmt], post[fmt]):
+                        self.violate(
+                            '%s kill drill [%s]: recovered query '
+                            'matches neither pre- nor post-build '
+                            'output' % (fmt, spec))
+                    litter = tree_tmp_litter(self.ctx['idx'][fmt])
+                    if litter:
+                        self.violate('%s kill drill [%s]: torn '
+                                     'shards: %s' % (fmt, spec,
+                                                     litter))
+            # leave the tree completed for the next spec/round
+            if fmt in post:
+                build(self.ctx, fmt)
+        # the goldens now describe the extended corpus
+        for fmt in FORMATS:
+            build(self.ctx, fmt)
+        self.golden = goldens(self.ctx)
+
+    def summary(self):
+        counters = mod_vpipe.global_counters()
+        per_site = {k[len('fault injected '):]: v
+                    for k, v in counters.items()
+                    if k.startswith('fault injected ')}
+        return {
+            'ops': self.ops,
+            'clean_errors': self.clean_errors,
+            'violations': self.violations,
+            'faults_injected_total': counters.get('faults injected',
+                                                  0),
+            'faults_by_site': per_site,
+            'recovery': {
+                k: counters.get(k, 0)
+                for k in ('index recovery rollbacks',
+                          'index recovery rollforwards',
+                          'index tmps quarantined')},
+            'remote_retries': counters.get('remote transport retries',
+                                           0),
+        }
+
+
+# the in-process mixed-fault spec: every site that can fire without
+# killing the soak process (kill/torn run under the subprocess drills)
+LOCAL_SPEC = ('sink.create:error:0.08:11,sink.flush:error:0.08:12,'
+              'sink.rename:error:0.05:13,iq.shard_read:error:0.10:14')
+DELAY_SPEC = 'iq.shard_read:delay:0.25:15,sink.flush:delay:0.2:16'
+REMOTE_SPEC = ('client.connect:error:0.12:21,client.send:error:0.08:22,'
+               'client.recv:error:0.10:23,serve.accept:error:0.08:24,'
+               'serve.read:error:0.06:25,serve.write:error:0.10:26')
+PROBE_SPEC = 'device.probe:error:1.0:31'
+# rate 1.0: the FIRST prepare/commit in the killed subprocess fires
+# deterministically — flush-phase kills drill the rollback (no commit
+# record yet; torn additionally leaves half-written bytes), rename-
+# phase kills drill the roll-forward (commit record on disk)
+KILL_SPECS = ('sink.flush:kill:1.0', 'sink.flush:torn:1.0',
+              'sink.rename:kill:1.0')
+KILL_SPECS_FAST = ('sink.flush:torn:1.0', 'sink.rename:kill:1.0')
+
+
+def soak(root, fast=False, verbose=True, floor=None):
+    """Run the soak under `root`; returns the summary dict.  `floor`
+    (injected-fault minimum) adds top-up local rounds until met."""
+    mod_faults.reset()
+    ctx = make_corpus(root, n=600 if fast else 2000,
+                      days=5 if fast else 16)
+    for fmt in FORMATS:
+        build(ctx, fmt)
+    s = Soak(ctx, verbose=verbose)
+
+    local_rounds = 3 if fast else 10
+    remote_rounds = 2 if fast else 8
+    s.note('local fault rounds (%d)' % local_rounds)
+    s.local_rounds(LOCAL_SPEC, local_rounds)
+    s.note('delay rounds')
+    s.local_rounds(DELAY_SPEC, 1 if fast else 2)
+    s.note('device-probe fault rounds')
+    s.local_rounds(PROBE_SPEC, 1, include_build=False,
+                   env={'DN_ENGINE': 'jax'})
+    s.note('remote fault rounds (%d)' % remote_rounds)
+    s.remote_rounds(REMOTE_SPEC, remote_rounds)
+    s.note('SIGKILL crash drills')
+    s.kill_rounds(KILL_SPECS_FAST if fast else KILL_SPECS,
+                  per_format=1 if fast else 2)
+    if floor:
+        # top up until the injected-fault floor is met (the PRNGs
+        # keep drawing, so extra rounds add fresh chaos)
+        extra = 0
+        while extra < 60:
+            total = mod_vpipe.global_counters().get('faults injected',
+                                                    0)
+            if total >= floor:
+                break
+            extra += 1
+            s.note('top-up round %d (%d/%d faults)'
+                   % (extra, total, floor))
+            s.local_rounds(LOCAL_SPEC, 1)
+    return s.summary()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument('--fast', action='store_true',
+                   help='miniature tier-1 variant')
+    p.add_argument('--min-faults', type=int, default=None,
+                   help='required injected-fault floor '
+                        '(default: 500, or 50 with --fast)')
+    args = p.parse_args(argv)
+    floor = args.min_faults if args.min_faults is not None \
+        else (50 if args.fast else 500)
+
+    import tempfile
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix='dn_soak_') as root:
+        summary = soak(root, fast=args.fast, floor=floor)
+    summary['elapsed_s'] = round(time.time() - t0, 1)
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    if summary['violations']:
+        print('soak: FAILED (%d violation(s))'
+              % len(summary['violations']), file=sys.stderr)
+        return 1
+    if summary['faults_injected_total'] < floor:
+        print('soak: FAILED (only %d faults injected; floor %d)'
+              % (summary['faults_injected_total'], floor),
+              file=sys.stderr)
+        return 1
+    print('soak: OK (%d ops, %d faults injected, 0 torn shards)'
+          % (summary['ops'], summary['faults_injected_total']),
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
