@@ -965,6 +965,13 @@ def serve_bench(tmpdir):
 
     reqs = st['requests']
     caches = st['caches']['shard_handles']
+    # the typed-metrics view (PR 7): per-op latency quantiles and the
+    # device engagement/residency gauges (ROADMAP open item 4's
+    # reporting half — honest zeros on CPU rigs)
+    mx = st.get('metrics') or {}
+    gauges = mx.get('gauges') or {}
+    hists = mx.get('histograms') or {}
+    qlat = hists.get('serve_op_latency_ms{op=query}') or {}
     return {
         'serve_records': n,
         'serve_shards': nshards,
@@ -983,6 +990,11 @@ def serve_bench(tmpdir):
         'serve_cache_hits': caches['hits'],
         'serve_cache_misses': caches['misses'],
         'device_path_engaged': st['device']['engaged'],
+        'device_mfu_pct': gauges.get('device_mfu_pct'),
+        'device_residency_pct': gauges.get('device_residency_pct'),
+        'device_engaged_gauge': gauges.get('device_engaged'),
+        'serve_query_latency_p50_ms': qlat.get('p50'),
+        'serve_query_latency_p99_ms': qlat.get('p99'),
         'serve_drained_clean': bool(drained),
     }
 

@@ -52,6 +52,7 @@ consume it unchanged.
 
 import json
 import os
+import time
 
 import numpy as np
 
@@ -218,6 +219,18 @@ def publish_counters(stage, parser):
         if value:
             stage.hidden.add(name)
             stage.counters[name] = value
+    # observability: the lane's accumulated parse wall time becomes
+    # one synthesized `byteparse` span (per-buffer spans would swamp
+    # the tree) plus an always-on stage histogram entry
+    seconds = getattr(parser, 'parse_seconds', None)
+    if seconds:
+        from .obs import metrics as obs_metrics
+        from .obs import trace as obs_trace
+        ms = seconds * 1000.0
+        obs_metrics.observe('stage_ms', ms, stage='byteparse')
+        obs_trace.add_span('byteparse', ms,
+                           lines=parser.nlines,
+                           fallback_lines=parser.lines_fb)
 
 
 # ---------------------------------------------------------------------------
@@ -465,6 +478,7 @@ class ByteParser(object):
         self.lines_fast = 0
         self.lines_fb = 0
         self.bytes_fast = 0
+        self.parse_seconds = 0.0
 
     # -- provider interface -------------------------------------------------
 
@@ -661,25 +675,32 @@ class ByteParser(object):
             buf = bytes(buf)
         if not buf:
             return 0
-        block = self.BLOCK
-        if len(buf) <= block + (block >> 2):
-            return self._absorb_block(self._scan_block(buf))
-        pieces = []
-        pos = 0
-        n = len(buf)
-        while pos < n:
-            end = min(pos + block, n)
-            if end < n:
-                nl = buf.rfind(b'\n', pos, end)
-                if nl < pos:
-                    nl = buf.find(b'\n', end)
-                    end = n if nl == -1 else nl + 1
-                else:
-                    end = nl + 1
-            pieces.append(buf[pos:end])
-            pos = end
-        return sum(self._absorb_block(self._scan_block(p))
-                   for p in pieces)
+        t0 = time.perf_counter()
+        try:
+            block = self.BLOCK
+            if len(buf) <= block + (block >> 2):
+                return self._absorb_block(self._scan_block(buf))
+            pieces = []
+            pos = 0
+            n = len(buf)
+            while pos < n:
+                end = min(pos + block, n)
+                if end < n:
+                    nl = buf.rfind(b'\n', pos, end)
+                    if nl < pos:
+                        nl = buf.find(b'\n', end)
+                        end = n if nl == -1 else nl + 1
+                    else:
+                        end = nl + 1
+                pieces.append(buf[pos:end])
+                pos = end
+            return sum(self._absorb_block(self._scan_block(p))
+                       for p in pieces)
+        finally:
+            # one perf_counter pair per buffer (buffers are large):
+            # the lane's accumulated wall time feeds the synthesized
+            # `byteparse` span and stage histogram (publish_counters)
+            self.parse_seconds += time.perf_counter() - t0
 
     def _scan_block(self, buf):
         """The stateless (thread-safe) half of block parsing: line

@@ -111,11 +111,20 @@ DN_OPTIONS = [
     (['pidfile'], 'string', None),
     (['points'], 'bool', None),
     (['port'], 'string', None),
+    # `dn stats`: render the Prometheus text exposition instead of
+    # the JSON stats document (docs/observability.md)
+    (['prom'], 'bool', None),
     (['raw'], 'bool', None),
     (['remote'], 'string', None),
     (['socket'], 'string', None),
     (['time-field'], 'string', None),
     (['time-format'], 'string', None),
+    # per-run request tracing (equivalent to DN_TRACE=stderr for one
+    # command; composes with --remote — the client ships its trace id
+    # and grafts the server's span subtree).  Not in USAGE_TEXT: the
+    # usage output is byte-pinned to the reference goldens; see
+    # docs/observability.md.
+    (['trace'], 'bool', None),
     (['validate'], 'bool', None),
     (['verbose', 'v'], 'bool', False),
     (['warnings'], 'bool', None),
@@ -575,6 +584,32 @@ def _mode_flag_env(optname, value, envname, allowed):
     return _env_scope(envname, value)
 
 
+def _obs_command(op, opts):
+    """Observability scope for one data command: installs a request
+    trace context when asked (--trace, DN_TRACE, DN_SLOW_MS) —
+    emitting one JSON span-tree line at command end — and nothing at
+    all otherwise (output stays byte-identical by construction:
+    tracing writes to the DN_TRACE sink / process stderr only when
+    armed).  --trace is DN_TRACE=stderr for one run, without
+    clobbering an explicit DN_TRACE target."""
+    import contextlib
+    import os
+    from .obs import trace as obs_trace
+
+    @contextlib.contextmanager
+    def scope():
+        explicit = bool(getattr(opts, 'trace', None))
+        value = 'stderr' if explicit and \
+            not os.environ.get('DN_TRACE') else None
+        with _env_scope('DN_TRACE', value):
+            if explicit or obs_trace.tracing_requested():
+                with obs_trace.request(op):
+                    yield
+            else:
+                yield
+    return scope()
+
+
 def _warn_printer(stage, kind, error):
     sys.stderr.write('warn: %s\n' % (getattr(error, 'message', None) or
                                      str(error)))
@@ -637,60 +672,67 @@ def cmd_scan(ctx, argv):
     opts = dn_parse_args(argv, ['before', 'after', 'filter', 'breakdowns',
                                 'raw', 'points', 'counters', 'warnings',
                                 'gnuplot', 'assetroot', 'dry-run',
-                                'parse', 'remote'])
+                                'parse', 'remote', 'trace'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     ds = datasource_for_name(ctx['config'], dsname)
     if isinstance(ds, DNError):
         fatal(ds)
     query = dn_query_config(opts)
-    if opts.remote:
-        rc = _try_remote(ctx, opts, {
-            'op': 'scan', 'ds': dsname,
-            'queryconfig': dn_query_doc(opts),
-            'opts': _remote_output_opts(opts),
-        })
-        if rc is not None:
-            return rc
-    warn_func = _warn_printer if getattr(opts, 'warnings', None) else None
-    with _mode_flag_env('parse', opts.parse, 'DN_PARSE',
-                        ('auto', 'host', 'vector', 'device')):
-        try:
-            result = ds.scan(query, dry_run=opts.dry_run,
-                             warn_func=warn_func)
-        except DNError as e:
-            fatal(e)
-    dn_output(query, opts, result, dsname)
+    with _obs_command('scan', opts):
+        if opts.remote:
+            rc = _try_remote(ctx, opts, {
+                'op': 'scan', 'ds': dsname,
+                'queryconfig': dn_query_doc(opts),
+                'opts': _remote_output_opts(opts),
+            })
+            if rc is not None:
+                return rc
+        warn_func = _warn_printer if getattr(opts, 'warnings', None) \
+            else None
+        with _mode_flag_env('parse', opts.parse, 'DN_PARSE',
+                            ('auto', 'host', 'vector', 'device')):
+            try:
+                result = ds.scan(query, dry_run=opts.dry_run,
+                                 warn_func=warn_func)
+            except DNError as e:
+                fatal(e)
+        dn_output(query, opts, result, dsname)
 
 
 def cmd_query(ctx, argv):
     opts = dn_parse_args(argv, ['before', 'after', 'filter', 'breakdowns',
                                 'raw', 'points', 'counters', 'interval',
                                 'gnuplot', 'assetroot', 'dry-run',
-                                'iq-threads', 'iq-stack', 'remote'])
+                                'iq-threads', 'iq-stack', 'remote',
+                                'trace'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     ds = datasource_for_name(ctx['config'], dsname)
     if isinstance(ds, DNError):
         fatal(ds)
     query = dn_query_config(opts)
-    if opts.remote:
-        rc = _try_remote(ctx, opts, {
-            'op': 'query', 'ds': dsname, 'interval': opts.interval,
-            'queryconfig': dn_query_doc(opts),
-            'opts': _remote_output_opts(opts),
-        })
-        if rc is not None:
-            return rc
+    with _obs_command('query', opts):
+        if opts.remote:
+            rc = _try_remote(ctx, opts, {
+                'op': 'query', 'ds': dsname,
+                'interval': opts.interval,
+                'queryconfig': dn_query_doc(opts),
+                'opts': _remote_output_opts(opts),
+            })
+            if rc is not None:
+                return rc
 
-    with _pool_flag_env('iq-threads', opts.iq_threads, 'DN_IQ_THREADS'), \
-            _mode_flag_env('iq-stack', opts.iq_stack, 'DN_IQ_STACK',
-                           ('auto', '0', '1')):
-        try:
-            result = ds.query(query, opts.interval, dry_run=opts.dry_run)
-        except DNError as e:
-            fatal(e)
-    dn_output(query, opts, result, dsname)
+        with _pool_flag_env('iq-threads', opts.iq_threads,
+                            'DN_IQ_THREADS'), \
+                _mode_flag_env('iq-stack', opts.iq_stack,
+                               'DN_IQ_STACK', ('auto', '0', '1')):
+            try:
+                result = ds.query(query, opts.interval,
+                                  dry_run=opts.dry_run)
+            except DNError as e:
+                fatal(e)
+        dn_output(query, opts, result, dsname)
 
 
 def _read_index_config(filename):
@@ -709,7 +751,7 @@ def cmd_build(ctx, argv):
     opts = dn_parse_args(argv, ['after', 'before', 'counters', 'dry-run',
                                 'index-config', 'interval', 'warnings',
                                 'assetroot', 'build-threads', 'parse',
-                                'remote'])
+                                'remote', 'trace'])
     check_arg_count(opts, 1)
     dsname = opts._args[0]
     indexcfg = _read_index_config(opts.index_config) \
@@ -729,37 +771,41 @@ def cmd_build(ctx, argv):
     if len(metrics) == 0:
         fatal(DNError('no metrics defined for dataset "%s"' % dsname))
 
-    if opts.remote:
-        rc = _try_remote(ctx, opts, {
-            'op': 'build', 'ds': dsname, 'interval': opts.interval,
-            'before': opts.before, 'after': opts.after,
-            'index_config': indexcfg,
-            'opts': _remote_output_opts(opts),
-        })
-        if rc is not None:
-            return rc
+    with _obs_command('build', opts):
+        if opts.remote:
+            rc = _try_remote(ctx, opts, {
+                'op': 'build', 'ds': dsname,
+                'interval': opts.interval,
+                'before': opts.before, 'after': opts.after,
+                'index_config': indexcfg,
+                'opts': _remote_output_opts(opts),
+            })
+            if rc is not None:
+                return rc
 
-    warn_func = _warn_printer if getattr(opts, 'warnings', None) else None
-    with _pool_flag_env('build-threads', opts.build_threads,
-                        'DN_BUILD_THREADS'), \
-            _mode_flag_env('parse', opts.parse, 'DN_PARSE',
-                           ('auto', 'host', 'vector', 'device')):
-        try:
-            result = ds.build(metrics, opts.interval,
-                              time_after=opts.after,
-                              time_before=opts.before,
-                              dry_run=opts.dry_run, warn_func=warn_func)
-        except DNError as e:
-            fatal(e)
+        warn_func = _warn_printer if getattr(opts, 'warnings', None) \
+            else None
+        with _pool_flag_env('build-threads', opts.build_threads,
+                            'DN_BUILD_THREADS'), \
+                _mode_flag_env('parse', opts.parse, 'DN_PARSE',
+                               ('auto', 'host', 'vector', 'device')):
+            try:
+                result = ds.build(metrics, opts.interval,
+                                  time_after=opts.after,
+                                  time_before=opts.before,
+                                  dry_run=opts.dry_run,
+                                  warn_func=warn_func)
+            except DNError as e:
+                fatal(e)
 
-    if opts.dry_run:
-        dn_output(None, opts, result, dsname)
-        return
-    from .parallel import distributed as mod_dist
-    if mod_dist.is_output_process():
-        sys.stderr.write('indexes for "%s" built\n' % dsname)
-        if getattr(opts, 'counters', None):
-            result.pipeline.dump_counters(sys.stderr)
+        if opts.dry_run:
+            dn_output(None, opts, result, dsname)
+            return
+        from .parallel import distributed as mod_dist
+        if mod_dist.is_output_process():
+            sys.stderr.write('indexes for "%s" built\n' % dsname)
+            if getattr(opts, 'counters', None):
+                result.pipeline.dump_counters(sys.stderr)
 
 
 def cmd_index_config(ctx, argv):
@@ -820,6 +866,40 @@ def cmd_index_read(ctx, argv):
         fatal(e)
 
 
+def cmd_stats(ctx, argv):
+    """`dn stats [--remote SOCK|HOST:PORT] [--prom]`: render a
+    resident server's /stats document (or its Prometheus metrics
+    exposition with --prom); without --remote, this process's own
+    metrics registry — mostly interesting after an in-process run.
+    Not in USAGE_TEXT (byte-pinned); documented in
+    docs/observability.md."""
+    opts = dn_parse_args(argv, ['remote', 'prom'])
+    check_arg_count(opts, 0)
+    if opts.remote:
+        from .serve import client as mod_serve_client
+        op = 'metrics' if getattr(opts, 'prom', None) else 'stats'
+        try:
+            rc, header, out, err = mod_serve_client.request_bytes(
+                opts.remote, {'op': op}, timeout_s=30.0)
+        except (OSError, ValueError, DNError) as e:
+            fatal(DNError('serve endpoint "%s" unreachable'
+                          % opts.remote, cause=DNError(str(e))))
+        sys.stderr.write(err.decode('utf-8', 'replace'))
+        sys.stdout.write(out.decode('utf-8', 'replace'))
+        return rc
+    from . import vpipe as mod_vpipe
+    from .obs import export as obs_export
+    counters = mod_vpipe.global_counters()
+    if getattr(opts, 'prom', None):
+        sys.stdout.write(obs_export.prometheus_text(counters=counters))
+        return 0
+    import json as mod_json
+    sys.stdout.write(mod_json.dumps(
+        obs_export.stats_section(counters=counters),
+        sort_keys=True, indent=2) + '\n')
+    return 0
+
+
 def cmd_serve(ctx, argv):
     """`dn serve --socket PATH | --port N [--pidfile P] [--validate]`:
     the resident query server (serve/server.py).  Not in USAGE_TEXT —
@@ -841,6 +921,9 @@ def cmd_serve(ctx, argv):
     faults_conf = mod_config.faults_config()
     if isinstance(faults_conf, DNError):
         fatal(faults_conf)
+    obs_conf = mod_config.obs_config()
+    if isinstance(obs_conf, DNError):
+        fatal(obs_conf)
 
     port = None
     if opts.port is not None:
@@ -870,6 +953,11 @@ def cmd_serve(ctx, argv):
             'connect_timeout_s=%d\n'
             % (remote_conf['retries'], remote_conf['backoff_ms'],
                remote_conf['connect_timeout_s']))
+        sys.stdout.write(
+            'obs config ok: trace=%s slow_ms=%s buckets=%d\n'
+            % (obs_conf['trace'] or 'off',
+               obs_conf['slow_ms'] if obs_conf['slow_ms'] is not None
+               else 'off', len(obs_conf['buckets'])))
         sites = faults_conf['sites']
         if sites:
             sys.stdout.write(
@@ -902,6 +990,7 @@ COMMANDS = {
     'query': cmd_query,
     'scan': cmd_scan,
     'serve': cmd_serve,
+    'stats': cmd_stats,
 }
 
 

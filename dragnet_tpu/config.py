@@ -399,6 +399,65 @@ def remote_config(env=None):
     return rv
 
 
+# --- observability knobs (DN_TRACE / DN_SLOW_MS / DN_METRICS_BUCKETS) -
+#
+# Same contract as the serve/remote knobs: parsed and validated in one
+# place, checked up front by `dn serve --validate` and serve startup;
+# the obs runtime itself reads the env forgivingly (a live daemon must
+# not crash on an env edit) — THIS is where malformed values are
+# rejected with the shared DNError contract.
+
+def obs_config(env=None):
+    """The resolved observability knobs (keys: trace, slow_ms,
+    buckets), or DNError on the first malformed value.
+
+    * DN_TRACE: '' (off), 'stderr', or a trace-file path (one JSON
+      span-tree line per request is appended).
+    * DN_SLOW_MS: integer >= 0; requests at/over the threshold write
+      their span tree to stderr.  Empty/unset disables.
+    * DN_METRICS_BUCKETS: comma-separated strictly-increasing positive
+      histogram upper bounds (ms); unset uses the default ladder.
+    """
+    if env is None:
+        env = os.environ
+    rv = {}
+    trace = env.get('DN_TRACE') or ''
+    if trace and trace != 'stderr':
+        parent = os.path.dirname(os.path.abspath(trace))
+        if not os.path.isdir(parent):
+            return DNError('DN_TRACE: expected "stderr" or a path in '
+                           'an existing directory, got "%s"' % trace)
+    rv['trace'] = trace or None
+    raw = env.get('DN_SLOW_MS')
+    if raw is None or raw == '':
+        rv['slow_ms'] = None
+    else:
+        try:
+            slow = int(raw)
+        except ValueError:
+            slow = -1
+        if slow < 0:
+            return DNError('DN_SLOW_MS: expected an integer >= 0, '
+                           'got "%s"' % raw)
+        rv['slow_ms'] = slow
+    raw = env.get('DN_METRICS_BUCKETS')
+    if raw is None or raw == '':
+        from .obs.metrics import DEFAULT_BUCKETS_MS
+        rv['buckets'] = list(DEFAULT_BUCKETS_MS)
+        return rv
+    try:
+        bounds = [float(p) for p in raw.split(',')]
+    except ValueError:
+        bounds = []
+    if not bounds or any(b <= 0 for b in bounds) or \
+            any(b >= c for b, c in zip(bounds, bounds[1:])):
+        return DNError('DN_METRICS_BUCKETS: expected a '
+                       'comma-separated strictly-increasing list of '
+                       'positive numbers, got "%s"' % raw)
+    rv['buckets'] = bounds
+    return rv
+
+
 # --- fault-injection spec (DN_FAULTS) ---------------------------------
 
 def faults_config(env=None):

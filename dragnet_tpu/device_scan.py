@@ -195,6 +195,9 @@ def audition_cache_get(key):
         ent = data.get(key)
         if not isinstance(ent, dict) or 'won' not in ent:
             return None
+        # wall clock ON PURPOSE (clock-audit, PR 7): `ts` persists
+        # across processes and reboots, where a monotonic reading is
+        # meaningless; an NTP step only widens/narrows the TTL once
         if time.time() - float(ent.get('ts', 0)) > _audition_ttl_s():
             return None
         return bool(ent['won'])
@@ -721,9 +724,12 @@ class DeviceScan(VectorScan):
         hung device plugin under DN_ENGINE=jax used to hang `dn scan`
         indefinitely here; now it warns and falls back to the host
         engine, which computes identical results."""
-        status, ok = run_with_deadline(self._probe_ok,
-                                       probe_deadline_s(),
-                                       'backend-probe')
+        from .obs import metrics as obs_metrics
+        with obs_metrics.timed_stage('device_scan.probe') as sp:
+            status, ok = run_with_deadline(self._probe_ok,
+                                           probe_deadline_s(),
+                                           'backend-probe')
+            sp.set(status=status)
         if status == 'timeout':
             import sys
             sys.stderr.write(
@@ -775,6 +781,13 @@ class DeviceScan(VectorScan):
         self._sync_device()
         elapsed = time.monotonic() - start
         rate = seen / elapsed if elapsed > 0 else float('inf')
+        if rate > 0 and elapsed > 0:
+            # the measured device rate feeds the device_mfu_pct /
+            # engagement gauges (obs/metrics.refresh_device_gauges)
+            import math
+            if math.isfinite(rate):
+                from .obs import metrics as obs_metrics
+                obs_metrics.set_gauge('device_records_per_sec', rate)
         if self._host_rate is not None and rate < self._host_rate:
             self._disabled = True
             LOG.info('device de-escalated (lost probation)',
@@ -1333,6 +1346,9 @@ class DeviceScan(VectorScan):
         self._ensure_acc(progs.acc_init, caps, ns,
                          sparse_cap=profile[-1])
         inputs[self._pfx + 'base'] = np.int64(self._acc_batch << 32)
+        _note_h2d(sum(int(getattr(v, 'nbytes', 0) or 0)
+                      for v in inputs.values()
+                      if isinstance(v, np.ndarray)))
         if self.capture_next:
             self.capture_next = False
             self.captured = (run, dict(inputs), staged, use_pallas)
@@ -2007,19 +2023,36 @@ def _sparse_program_full(cap, k):
     return prog
 
 
+def _note_h2d(nbytes):
+    """Host->device transfer accounting (always-on counter; traces
+    see the totals as span attrs on device_scan.fetch/probe)."""
+    if nbytes:
+        from .obs import metrics as obs_metrics
+        obs_metrics.inc('device_h2d_bytes', int(nbytes))
+
+
 def _fetch_arrays(arrays):
     """np.asarray over several device arrays; DN_PARALLEL_FETCH=1
     fetches them on a small thread pool (measured ~40% faster over the
     tunnel, but concurrent transfers can deadlock some device plugins,
     so sequential is the safe default)."""
     import os
+    from .obs import metrics as obs_metrics
+    from .obs import trace as obs_trace
     arrays = list(arrays)
-    if len(arrays) <= 1 or \
-            os.environ.get('DN_PARALLEL_FETCH', '0') != '1':
-        return [np.asarray(a) for a in arrays]
-    import concurrent.futures as cf
-    with cf.ThreadPoolExecutor(min(4, len(arrays))) as ex:
-        return list(ex.map(np.asarray, arrays))
+    with obs_trace.span('device_scan.d2h', narrays=len(arrays)) as sp:
+        if len(arrays) <= 1 or \
+                os.environ.get('DN_PARALLEL_FETCH', '0') != '1':
+            out = [np.asarray(a) for a in arrays]
+        else:
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(min(4, len(arrays))) as ex:
+                out = list(ex.map(np.asarray, arrays))
+        nbytes = sum(int(a.nbytes) for a in out)
+        if nbytes:
+            obs_metrics.inc('device_d2h_bytes', nbytes)
+            sp.set(bytes=nbytes)
+    return out
 
 
 def _decode_fused(keys, caps):

@@ -154,6 +154,13 @@ def fire(site, torn_path=None):
         return
     counter_bump('faults injected')
     counter_bump('fault injected %s' % site)
+    # observability: firings land as span events (chaos soaks become
+    # traceable — the trace shows exactly which request absorbed which
+    # injection) and as a typed counter in /stats `metrics`
+    from .obs import metrics as obs_metrics
+    from .obs import trace as obs_trace
+    obs_metrics.inc('faults_injected_total', site=site, kind=ent.kind)
+    obs_trace.event('fault.injected', site=site, kind=ent.kind)
     kind = ent.kind
     if kind == 'delay':
         time.sleep(_delay_s())

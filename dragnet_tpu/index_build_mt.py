@@ -292,18 +292,21 @@ def publish_prepared(journal, sinks, paths):
     (index_journal.cleanup_own_stale).  The earliest bucket-order
     error still re-raises so the caller reports the failure."""
     from .index_query_mt import shard_cache_invalidate
-    journal.record_commit(paths)
-    err = None
-    for sink, path in zip(sinks, paths):
-        try:
-            sink.commit(discard_on_error=False)
-            shard_cache_invalidate(path)
-        except BaseException as e:
-            if err is None:
-                err = e
-    if err is not None:
-        raise err
-    journal.retire()
+    from .obs import metrics as obs_metrics
+    with obs_metrics.timed_stage('index_build.commit',
+                                 nshards=len(paths)):
+        journal.record_commit(paths)
+        err = None
+        for sink, path in zip(sinks, paths):
+            try:
+                sink.commit(discard_on_error=False)
+                shard_cache_invalidate(path)
+            except BaseException as e:
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+        journal.retire()
 
 
 def _publish_buckets(metrics, indexroot, buckets, catalog, nworkers):
@@ -317,6 +320,8 @@ def _publish_buckets(metrics, indexroot, buckets, catalog, nworkers):
     contract: the earliest bucket-order error re-raises and no tmp
     litter survives."""
     from . import index_journal as mod_journal
+    from .obs import metrics as obs_metrics
+    from .obs import trace as obs_trace
 
     mod_journal.sweep_index_tree(indexroot)
     mod_journal.cleanup_own_stale(indexroot)
@@ -327,14 +332,17 @@ def _publish_buckets(metrics, indexroot, buckets, catalog, nworkers):
                            journal.tmp_suffix, sinks, i)
              for i, (path, config, parts) in enumerate(buckets)]
     try:
-        run_flush_tasks(tasks, nworkers)
+        with obs_metrics.timed_stage('index_build.prepare',
+                                     nshards=len(buckets)):
+            run_flush_tasks(tasks, nworkers)
     except BaseException:
         for sink in sinks:
             if sink is not None:
                 sink.abort()
         raise
-    publish_prepared(journal, sinks, paths)
-    _notify_index_written(indexroot, paths)
+    with obs_trace.span('index_build.publish', nshards=len(paths)):
+        publish_prepared(journal, sinks, paths)
+        _notify_index_written(indexroot, paths)
 
 
 def write_index_blocks(metrics, interval, indexroot, blocks,

@@ -139,10 +139,16 @@ class BuildJournal(object):
         or the recovery sweep's roll-forward)."""
         self.entries = [(self.tmp_for(os.path.abspath(p)),
                          os.path.abspath(p)) for p in final_paths]
+        # wall clock ON PURPOSE (clock-audit, PR 7): this is a
+        # forensic timestamp in a persisted record read across
+        # processes, never a duration — monotonic would be meaningless
         doc = {'pid': os.getpid(), 'build_id': self.build_id,
                'state': 'commit', 'time': time.time(),
                'entries': [[t, f] for t, f in self.entries]}
         tmp = self.path + '.tmp'
+        # a zero-bucket build never had a sink create indexroot, but
+        # the commit record still lands there
+        os.makedirs(self.indexroot, exist_ok=True)
         with open(tmp, 'w') as f:
             f.write(json.dumps(doc))
             f.flush()

@@ -528,19 +528,38 @@ def query_shard_once(path, query):
         querier.close()
 
 
+def _shard_obs(path, stacked=False):
+    """Per-shard observability, tuned for the hot path: the span (and
+    its attr construction — basename, kwargs) only exists when a
+    trace context is live; the shard_read_ms histogram is always on
+    but costs one lock + a few adds."""
+    from .obs import trace as obs_trace
+    if obs_trace.current_trace() is None:
+        return obs_trace.NULL_SPAN
+    return obs_trace.span('index_query_mt.shard',
+                          shard=os.path.basename(path),
+                          stacked=stacked)
+
+
 def _query_shard_cached(path, query):
+    from time import perf_counter
+    from .obs import metrics as obs_metrics
     handle = checkout_shard(path)
     ok = False
+    t0 = perf_counter()
     try:
-        mod_faults.fire('iq.shard_read')
-        sub = Aggregator(query)
-        handle.querier.run(query, aggr=sub)
-        items = list(sub.key_items())
+        with _shard_obs(path):
+            mod_faults.fire('iq.shard_read')
+            sub = Aggregator(query)
+            handle.querier.run(query, aggr=sub)
+            items = list(sub.key_items())
         ok = True
         return items
     except DNError as e:
         raise DNError('index "%s" query' % path, cause=e)
     finally:
+        obs_metrics.observe('shard_read_ms',
+                            (perf_counter() - t0) * 1000.0)
         checkin_shard(handle, ok=ok)
 
 
@@ -572,27 +591,33 @@ def _load_shard_blocks_cached(path, query, memo):
     reports the same way whichever execution mode hit it, and the
     failed handle is closed (never re-cached) by the ok=False
     checkin."""
+    from time import perf_counter
+    from .obs import metrics as obs_metrics
     handle = checkout_shard(path)
     ok = False
+    t0 = perf_counter()
     try:
-        mod_faults.fire('iq.shard_read')
-        querier = handle.querier
-        plan = memo.get(_catalog_sig(querier))
-        if plan is None:
-            table = querier.find_metric(query)
-            if isinstance(table, DNError):
-                raise table
-            filt = querier._compose_filter(query, table)
-            groupby = querier._groupby_columns(query)
-            plan = (table, filt, groupby)
-            memo[_catalog_sig(querier)] = plan
-        table, filt, groupby = plan
-        blocks = querier.stack_blocks(table, filt, groupby)
+        with _shard_obs(path, stacked=True):
+            mod_faults.fire('iq.shard_read')
+            querier = handle.querier
+            plan = memo.get(_catalog_sig(querier))
+            if plan is None:
+                table = querier.find_metric(query)
+                if isinstance(table, DNError):
+                    raise table
+                filt = querier._compose_filter(query, table)
+                groupby = querier._groupby_columns(query)
+                plan = (table, filt, groupby)
+                memo[_catalog_sig(querier)] = plan
+            table, filt, groupby = plan
+            blocks = querier.stack_blocks(table, filt, groupby)
         ok = True
         return blocks
     except DNError as e:
         raise DNError('index "%s" query' % path, cause=e)
     finally:
+        obs_metrics.observe('shard_read_ms',
+                            (perf_counter() - t0) * 1000.0)
         checkin_shard(handle, ok=ok)
 
 

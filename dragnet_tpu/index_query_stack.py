@@ -568,8 +568,11 @@ def run_stacked(paths, query, aggr, index_list):
         shards.append(sh)
         vals_list.append(v)
 
+    from .obs import metrics as obs_metrics
     try:
-        mod_iqmt.run_shard_loads(paths, query, on_blocks)
+        with obs_metrics.timed_stage('index_query_stack.load',
+                                     nshards=len(paths)):
+            mod_iqmt.run_shard_loads(paths, query, on_blocks)
     except _GateFailed:
         return False
     nshards = len(shards)
@@ -635,13 +638,16 @@ def run_stacked(paths, query, aggr, index_list):
     # exactly the order the sequential loop scans groups; the first
     # occurrence of each aggregate tuple in this order IS its flat-map
     # insertion position
-    perm = _order_rows(shard_ids, sort_cols)
-    acols = [c[perm] for c in agg_cols]
-    first_idx, inv, order = _unique_rows(acols)
+    with obs_metrics.timed_stage('index_query_stack.sort', nrows=n):
+        perm = _order_rows(shard_ids, sort_cols)
+        acols = [c[perm] for c in agg_cols]
+        first_idx, inv, order = _unique_rows(acols)
     nuniq = len(first_idx)
 
-    wsum = _aggregate_weights(inv, values[perm], nuniq,
-                              stage=index_list)
+    with obs_metrics.timed_stage('index_query_stack.aggregate',
+                                 nuniq=nuniq):
+        wsum = _aggregate_weights(inv, values[perm], nuniq,
+                                  stage=index_list)
     rows = first_idx[order]
     out_cols = [np.ascontiguousarray(c[rows]) for c in acols]
     weights = [int(w) for w in wsum[order].tolist()]

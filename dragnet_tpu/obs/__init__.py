@@ -1,0 +1,21 @@
+"""Observability: structured request tracing, typed metrics, exports.
+
+Three modules, one contract (docs/observability.md):
+
+* ``metrics``  — typed, merge-able registry (counter / gauge /
+  fixed-bucket histogram).  Always on, lock-cheap: serving requests
+  accumulate into a per-request registry that merges into the global
+  one when the request ends, so concurrent requests never contend on
+  the hot path.
+* ``trace``    — per-request span trees riding the vpipe request
+  scope (worker pools adopt their submitter's scope, so pool-thread
+  spans attribute to the right request).  Fully off unless DN_TRACE /
+  DN_SLOW_MS / ``--trace`` ask for it; one JSON line per request.
+* ``export``   — the /stats ``metrics`` section (versioned, with
+  histogram quantiles) and Prometheus text exposition (the serve
+  ``metrics`` op, ``dn stats --prom``).
+"""
+
+from . import metrics        # noqa: F401
+from . import trace          # noqa: F401
+from . import export         # noqa: F401

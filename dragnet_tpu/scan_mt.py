@@ -239,8 +239,10 @@ class MTScanExecutor(object):
 
     def __init__(self, nworkers, build_worker, apply_result,
                  main_pipeline, stage_offset):
+        import time as mod_time
         from .vpipe import Pipeline
         self.closed = False
+        self._t0 = mod_time.perf_counter()
         _EXECUTOR_LEAKS.track(self)
         self.nworkers = nworkers
         self.apply_result = apply_result
@@ -273,6 +275,8 @@ class MTScanExecutor(object):
             self._worker_loop(build_worker, wp)
 
     def _worker_loop(self, build_worker, wp):
+        import time as mod_time
+        from .obs import metrics as obs_metrics
         try:
             process = build_worker(wp)
         except BaseException as e:  # surface setup failures at submit
@@ -287,7 +291,12 @@ class MTScanExecutor(object):
                 self.resultq.put((seq, None))
                 continue
             try:
-                self.resultq.put((seq, process(snap)))
+                t0 = mod_time.perf_counter()
+                result = process(snap)
+                obs_metrics.observe(
+                    'scan_batch_ms',
+                    (mod_time.perf_counter() - t0) * 1000.0)
+                self.resultq.put((seq, result))
             except BaseException as e:
                 self.errors.append(e)
                 self.resultq.put((seq, None))
@@ -331,7 +340,16 @@ class MTScanExecutor(object):
     def finish(self):
         """Drain everything, merge worker counters into the main
         pipeline, and re-raise the first worker error."""
+        import time as mod_time
+        from .obs import trace as obs_trace
         self.close()
+        # one synthesized span for the whole fan-out (per-batch spans
+        # would swamp the tree; per-batch latency lives in the
+        # always-on scan_batch_ms histogram instead)
+        obs_trace.add_span(
+            'scan_mt.fanout',
+            (mod_time.perf_counter() - self._t0) * 1000.0,
+            nworkers=self.nworkers, batches=self.seq)
         if self.errors:
             raise self.errors[0]
         main_stages = self.main_pipeline.stages[self.stage_offset:]

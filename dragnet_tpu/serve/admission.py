@@ -38,6 +38,7 @@ import threading
 from contextlib import contextmanager
 
 from ..errors import DNError
+from ..obs import metrics as obs_metrics
 
 
 class BusyError(DNError):
@@ -110,6 +111,7 @@ class Admission(object):
                                     'admitted; retry another replica')
             if self._inflight < self.max_inflight:
                 self._inflight += 1
+                obs_metrics.observe('serve_queue_wait_ms', 0.0)
                 return Slot(self)
             if self._queued >= self.queue_depth:
                 raise BusyError(
@@ -119,12 +121,15 @@ class Admission(object):
                        self.queue_depth))
             self._queued += 1
             try:
-                while self._inflight >= self.max_inflight:
-                    if self._draining:
-                        raise DrainingError(
-                            'server draining: request not admitted; '
-                            'retry another replica')
-                    self._cond.wait()
+                with obs_metrics.timed_stage(
+                        'serve.queue_wait',
+                        metric='serve_queue_wait_ms', labels={}):
+                    while self._inflight >= self.max_inflight:
+                        if self._draining:
+                            raise DrainingError(
+                                'server draining: request not '
+                                'admitted; retry another replica')
+                        self._cond.wait()
             finally:
                 self._queued -= 1
             self._inflight += 1
@@ -232,7 +237,11 @@ class Coalescer(object):
                 self._stats['coalesced'] += 1
                 leader = False
         if not leader:
-            if not ex.done.wait(_FOLLOW_CAP_S):
+            with obs_metrics.timed_stage(
+                    'serve.coalesce_wait',
+                    metric='serve_coalesce_wait_ms', labels={}):
+                done = ex.done.wait(_FOLLOW_CAP_S)
+            if not done:
                 raise DeadlineError('coalesced execution never '
                                     'completed')
             if ex.error is not None:

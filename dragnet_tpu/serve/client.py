@@ -45,6 +45,7 @@ import time
 
 from ..errors import DNError
 from .. import faults as mod_faults
+from ..obs import trace as obs_trace
 from ..vpipe import counter_bump
 
 CHUNK = 1 << 16
@@ -234,19 +235,33 @@ def request(remote, req, timeout_s=None):
     response through this process's stdout/stderr.  Returns the
     remote exit code.  Raises RemoteUnreachable while falling back is
     still safe, RemoteRetryExhausted / RemoteTransportError when it
-    is not."""
+    is not.
+
+    Trace propagation: when this process has an active trace context
+    (DN_TRACE / DN_SLOW_MS / --trace), the request carries the
+    CLIENT-generated trace id in its ``trace`` header and asks the
+    server for its span subtree, which is grafted under this
+    request's exchange span — one joined client+server tree."""
     if timeout_s is None:
         timeout_s = _default_timeout_s()
+    tctx = obs_trace.current_trace()
+    if tctx is not None and 'trace' not in req:
+        req = dict(req, trace={'id': tctx.trace_id, 'want': True})
 
     def stream_through(header, f):
+        if tctx is not None:
+            remote_doc = (header.get('stats') or {}).get('trace')
+            if remote_doc:
+                tctx.graft(remote_doc.get('spans') or remote_doc)
         for size, stream in ((header.get('nout', 0), sys.stdout),
                              (header.get('nerr', 0), sys.stderr)):
             for chunk in _read_exact(f, size):
                 _write_bytes(stream, chunk)
         return int(header.get('rc', 1))
 
-    return _exchange_with_retry(remote, req, timeout_s,
-                                stream_through)
+    with obs_trace.span('remote.exchange', endpoint=str(remote)):
+        return _exchange_with_retry(remote, req, timeout_s,
+                                    stream_through)
 
 
 def request_bytes(remote, req, timeout_s=60.0, retry=False):

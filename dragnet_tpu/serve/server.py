@@ -51,6 +51,9 @@ from .. import vpipe as mod_vpipe
 from .. import index_query_mt as mod_iqmt
 from .. import log as mod_log
 from ..errors import DNError
+from ..obs import export as obs_export
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..watchdog import LeakCheck
 from . import admission as mod_admission
 from . import lifecycle as mod_lifecycle
@@ -254,7 +257,10 @@ class DnServer(object):
         self._idem_lock = threading.Lock()
         self._idem = {}
         self._by_op = {}
-        self._t0 = time.time()
+        # monotonic for durations (uptime_s must not jump when NTP
+        # steps the wall clock); wall time kept only as a timestamp
+        self._t0 = time.monotonic()
+        self._started_wall = time.time()
         self._hook = None
         self._thread = None
         # per-index-tree reader/writer locks (admission.TreeLock):
@@ -381,7 +387,8 @@ class DnServer(object):
         requests.update(self.coalescer.stats())
         doc = {
             'pid': os.getpid(),
-            'uptime_s': round(time.time() - self._t0, 3),
+            'uptime_s': round(time.monotonic() - self._t0, 3),
+            'started_at': round(self._started_wall, 3),
             'socket': self.socket_path,
             'port': self.bound_port,
             'draining': self.draining,
@@ -405,6 +412,10 @@ class DnServer(object):
                          for k in ('index recovery rollbacks',
                                    'index recovery rollforwards',
                                    'index tmps quarantined')},
+            # the typed registry (obs/metrics.py): versioned so
+            # dashboards can gate on shape; histograms carry
+            # p50/p90/p99 and cumulative buckets
+            'metrics': obs_export.stats_section(counters=counters),
         }
         try:
             from ..device_scan import _audition_cache_file
@@ -486,13 +497,20 @@ class DnServer(object):
             body = json.dumps({
                 'ok': not self.draining, 'draining': self.draining,
                 'pid': os.getpid(),
-                'uptime_s': round(time.time() - self._t0, 3),
+                'uptime_s': round(time.monotonic() - self._t0, 3),
                 'inflight': self.admission.depth(),
             }, sort_keys=True) + '\n'
             return 0, body.encode(), b'', {}
         if op == 'stats':
             body = json.dumps(self.stats_doc(), sort_keys=True,
                               indent=2) + '\n'
+            return 0, body.encode(), b'', {}
+        if op == 'metrics':
+            # Prometheus text exposition of the typed registry (the
+            # scrape endpoint; `dn stats --remote S --prom` renders
+            # it).  Like stats/health: never queued behind admission.
+            body = obs_export.prometheus_text(
+                counters=mod_vpipe.global_counters())
             return 0, body.encode(), b'', {}
         if op == 'build' and req.get('idempotency'):
             return self._execute_idempotent(req['idempotency'], req)
@@ -565,12 +583,29 @@ class DnServer(object):
         flags = {'coalesced': False, 'busy': False, 'deadline': False,
                  'draining': False}
         scope_out = {}
+        op = req.get('op')
+
+        # observability context: the scoped metrics registry is
+        # always on (merged into the global registry at request end);
+        # the span tree exists only when the client's trace header or
+        # this process's DN_TRACE / DN_SLOW_MS asked for one.  The
+        # client-generated trace id joins the server's tree to its
+        # client's.
+        treq = req.get('trace') or {}
+        want_trace = bool(treq.get('want')) or \
+            obs_trace.tracing_requested()
+        tctx = obs_trace.TraceContext('serve.' + str(op),
+                                      trace_id=treq.get('id')) \
+            if want_trace else None
+        obs_ctx = obs_trace.ObsContext(
+            trace=tctx, registry=obs_metrics.Registry())
 
         def job():
             # may run on the worker thread OR a deadline-armor
             # thread: stdio binding and the counter scope are
             # thread-local, so both bind in here
             with bound_stdio(cap), mod_vpipe.request_scope() as sc:
+                sc.obs = obs_ctx
                 try:
                     rc = self._run_data(req, flags)
                 except mod_admission.BusyError as e:
@@ -605,6 +640,35 @@ class DnServer(object):
                 scope_out.update(sc)
             return rc
 
+        def finish_obs(rc, extra):
+            """Request-end accounting: merge the scoped registry,
+            record the per-op end-to-end latency, and emit/attach the
+            span tree.  The subtree travels in the response header
+            only when the CLIENT's trace header asked (its tracer
+            grafts it) — /stats and response bytes stay byte-identical
+            with tracing off."""
+            elapsed_ms = (time.monotonic() - t0) * 1000.0
+            reg = obs_metrics.global_registry()
+            reg.merge(obs_ctx.registry)
+            reg.observe('serve_op_latency_ms', elapsed_ms,
+                        op=str(op))
+            if rc != 0:
+                reg.inc('serve_errors_total', op=str(op))
+            if tctx is not None:
+                # never let telemetry replace a response: a
+                # deadline-abandoned job thread may still be mutating
+                # this tree while we serialize it
+                try:
+                    if rc != 0:
+                        tctx.root.add_event('error', {'rc': rc})
+                    if treq.get('want'):
+                        extra['trace'] = tctx.to_doc()
+                    obs_trace.emit_trace(tctx)
+                except Exception as e:
+                    extra.pop('trace', None)
+                    self.log.error('trace emit failed', err=repr(e))
+            return extra
+
         if deadline_ms and deadline_ms > 0:
             from ..device_scan import run_with_deadline
             status, rv = run_with_deadline(job, deadline_ms / 1000.0,
@@ -627,9 +691,13 @@ class DnServer(object):
                                        flags.get('ex'))
                 self._bump('deadline_expired')
                 self._bump('errors')
+                if tctx is not None:
+                    tctx.root.add_event('deadline_expired',
+                                        {'deadline_ms': deadline_ms})
                 msg = ('%s: request deadline (%d ms) exceeded\n'
                        % (mod_cli.ARG0, deadline_ms))
-                return 1, b'', msg.encode(), {'deadline_expired': True}
+                return 1, b'', msg.encode(), finish_obs(
+                    1, {'deadline_expired': True})
             rc = rv if status == 'ok' else 1
         else:
             rc = job()
@@ -652,7 +720,7 @@ class DnServer(object):
             # the request was never admitted: nothing ran, a retry is
             # always safe — the client's backoff loop keys off this
             extra['retryable'] = True
-        return rc, out, err, extra
+        return rc, out, err, finish_obs(rc, extra)
 
     def _tree_lock(self, ds, dsname):
         # normalized, so '/data/idx' and '/data/idx/' (or a relative
@@ -701,15 +769,16 @@ class DnServer(object):
         def compute():
             slot = flags['slot'] = self.admission.acquire()
             try:
-                if op == 'scan':
-                    # raw-data scans never read the index tree, so
-                    # they run unlocked alongside builds
-                    return ds.scan(query, dry_run=opts.dry_run,
-                                   warn_func=None)
-                with self._tree_lock(ds, dsname).read():
-                    return ds.query(query,
-                                    req.get('interval') or 'day',
-                                    dry_run=opts.dry_run)
+                with obs_trace.span('serve.execute', op=op):
+                    if op == 'scan':
+                        # raw-data scans never read the index tree,
+                        # so they run unlocked alongside builds
+                        return ds.scan(query, dry_run=opts.dry_run,
+                                       warn_func=None)
+                    with self._tree_lock(ds, dsname).read():
+                        return ds.query(query,
+                                        req.get('interval') or 'day',
+                                        dry_run=opts.dry_run)
             finally:
                 slot.release()
 
@@ -748,7 +817,8 @@ class DnServer(object):
                                   '"%s"' % dsname))
         slot = flags['slot'] = self.admission.acquire()
         try:
-            with self._tree_lock(ds, dsname).write():
+            with self._tree_lock(ds, dsname).write(), \
+                    obs_trace.span('serve.execute', op='build'):
                 result = ds.build(metrics, interval,
                                   time_after=after,
                                   time_before=before,

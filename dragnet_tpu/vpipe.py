@@ -32,6 +32,22 @@ _GLOBAL_LOCK = threading.Lock()
 _GLOBAL_COUNTERS = {}
 
 
+class Scope(dict):
+    """One request's counter snapshot.  A dict (the counter deltas the
+    docstring above describes) plus one extra slot: `obs`, the
+    request's observability context (obs/trace.py spans + scoped
+    metrics).  Because worker pools capture and adopt THE SCOPE OBJECT
+    (current_scope/adopt_scope), hanging the obs context off it means
+    pool-thread spans and metrics attribute to the submitting request
+    with zero extra plumbing."""
+
+    __slots__ = ('obs',)
+
+    def __init__(self):
+        super(Scope, self).__init__()
+        self.obs = None
+
+
 def counter_bump(counter, n=1):
     """Bump a process-global telemetry counter, request-scoped when a
     scope is active on this thread (see module docstring).  Scope
@@ -53,7 +69,9 @@ def request_scope():
     (yielded), merging them into the global store — or the enclosing
     scope — on exit.  The serving layer wraps every request in one."""
     prior = getattr(_SCOPE_TLS, 'scope', None)
-    scope = {}
+    scope = Scope()
+    # a nested scope still belongs to the enclosing request's trace
+    scope.obs = getattr(prior, 'obs', None)
     _SCOPE_TLS.scope = scope
     try:
         yield scope

@@ -170,6 +170,45 @@ def test_serve_config_rejects_bad_values():
     assert str(err) == 'DN_SERVE_COALESCE: expected 0 or 1, got "yes"'
 
 
+# -- observability knob validation (DN_TRACE / DN_SLOW_MS /
+# DN_METRICS_BUCKETS; dn serve --validate covers these too) ----------------
+
+def test_obs_config_defaults():
+    conf = mod_config.obs_config(env={})
+    assert conf['trace'] is None
+    assert conf['slow_ms'] is None
+    assert len(conf['buckets']) == 14
+
+
+def test_obs_config_parses_overrides(tmp_path):
+    conf = mod_config.obs_config(env={
+        'DN_TRACE': 'stderr', 'DN_SLOW_MS': '250',
+        'DN_METRICS_BUCKETS': '1,5,25'})
+    assert conf == {'trace': 'stderr', 'slow_ms': 250,
+                    'buckets': [1.0, 5.0, 25.0]}
+    path = str(tmp_path / 'trace.jsonl')
+    conf = mod_config.obs_config(env={'DN_TRACE': path})
+    assert conf['trace'] == path
+
+
+def test_obs_config_rejects_bad_values():
+    err = mod_config.obs_config(env={'DN_SLOW_MS': 'x'})
+    assert isinstance(err, DNError)
+    assert str(err) == 'DN_SLOW_MS: expected an integer >= 0, got "x"'
+    err = mod_config.obs_config(env={'DN_SLOW_MS': '-5'})
+    assert isinstance(err, DNError)
+    err = mod_config.obs_config(
+        env={'DN_TRACE': '/no/such/dir/trace.jsonl'})
+    assert isinstance(err, DNError)
+    assert 'DN_TRACE' in str(err)
+    for bad in ('x', '5,2', '0,1', '-1,2', ''):
+        if bad == '':
+            continue
+        err = mod_config.obs_config(env={'DN_METRICS_BUCKETS': bad})
+        assert isinstance(err, DNError), bad
+        assert str(err).startswith('DN_METRICS_BUCKETS: expected')
+
+
 def test_backend_load_returns_fresh_config_on_error(tmp_path):
     p = tmp_path / 'rc'
     p.write_text('{"vmaj": 0, "vmin": 0, "datasources": [{}], '

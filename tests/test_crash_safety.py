@@ -93,6 +93,19 @@ def test_sweep_rolls_forward_committed_journal(tmp_path):
     assert not tmp.exists() and not jpath.exists()
 
 
+def test_commit_record_creates_missing_indexroot(tmp_path):
+    # a zero-bucket build (empty/nonexistent data) never has a sink
+    # create the index root, but the commit record still lands there —
+    # used to crash with FileNotFoundError instead of publishing an
+    # empty build cleanly
+    idx = tmp_path / 'never_created' / 'idx'
+    journal = mod_journal.BuildJournal(str(idx))
+    journal.record_commit([])
+    assert os.path.exists(journal.path)
+    journal.retire()
+    assert not os.path.exists(journal.path)
+
+
 def test_sweep_quarantines_torn_journal_record(tmp_path):
     idx = tmp_path / 'idx'
     idx.mkdir()

@@ -805,7 +805,9 @@ def test_serve_validate_ok(monkeypatch):
     assert out == (b'serve config ok: max_inflight=3 queue_depth=16 '
                    b'deadline_ms=2500 coalesce=1 drain_s=30\n'
                    b'remote config ok: retries=2 backoff_ms=50 '
-                   b'connect_timeout_s=5\n')
+                   b'connect_timeout_s=5\n'
+                   b'obs config ok: trace=off slow_ms=off '
+                   b'buckets=14\n')
 
 
 def test_serve_validate_reports_armed_faults(monkeypatch):
