@@ -5,7 +5,8 @@
 PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
-    bench-serve soak-faults clean parity-matrix
+    bench-serve bench-cluster soak-faults soak-cluster clean \
+    parity-matrix
 
 all: native
 
@@ -54,6 +55,20 @@ bench-serve: native
 # byte-identical output vs a fault-free run (docs/robustness.md)
 soak-faults: native
 	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py
+
+# the scatter-gather cluster drill: 3 members x 2-replica partitions
+# under armed router/member/transport faults, a SIGKILL'd partition
+# owner mid-query, and a no-surviving-replica degraded check —
+# asserts byte-identity whenever a replica survives and the clean
+# degraded-or-error contract when none does (docs/serving.md)
+soak-cluster: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --cluster
+
+# the cluster serving legs only: scatter-gather p50/p95 vs the
+# single-server path, failover-added latency with one member killed,
+# and hedge fire rate (bench extras JSON)
+bench-cluster: native
+	$(PYTHON) bench.py --cluster-only
 
 # golden byte-parity under every engine (the strongest single seal:
 # host per-record, vectorized, forced device, auto router), then the

@@ -1032,6 +1032,228 @@ def main_serve():
     }))
 
 
+def cluster_bench(tmpdir):
+    """The scatter-gather cluster legs (--cluster-only / make
+    bench-cluster): the same warm index-query workload as bench-serve,
+    measured three ways — a single resident server (the PR 5 shape,
+    the baseline), a 3-member x 2-replica `dn serve` cluster routing
+    through one member (scatter + partial merge cost), and the same
+    cluster after SIGKILLing a partition owner (failover-added
+    latency: every partition still has a live replica, so bytes stay
+    identical while the router pays the dead-primary dial).  Hedging
+    is armed (DN_BENCH_CLUSTER_HEDGE_MS floor) so the hedge fire rate
+    under real latencies lands in the extras."""
+    import shutil
+    import signal
+    import subprocess
+    from dragnet_tpu import config as mod_config
+    from dragnet_tpu.serve import client as mod_scl
+    from dragnet_tpu.serve import lifecycle as mod_lc
+
+    n = int(os.environ.get('DN_BENCH_CLUSTER_RECORDS', '200000'))
+    days = int(os.environ.get('DN_BENCH_CLUSTER_DAYS', '120'))
+    warm_reps = int(os.environ.get('DN_BENCH_CLUSTER_WARM_REPS', '25'))
+    hedge_ms = os.environ.get('DN_BENCH_CLUSTER_HEDGE_MS', '8')
+
+    datafile = os.path.join(tmpdir, 'cluster.log')
+    idx = os.path.join(tmpdir, 'cluster.idx')
+    rc_path = os.path.join(tmpdir, 'cluster_rc.json')
+    start_ms = 1388534400000             # 2014-01-01
+    gen_to_file(n, datafile, mindate_ms=start_ms,
+                maxdate_ms=start_ms + days * 86400000)
+
+    cfg = mod_config.create_initial_config()
+    cfg = cfg.datasource_add({
+        'name': 'clusterbench', 'backend': 'file',
+        'backend_config': {'path': datafile, 'indexPath': idx,
+                           'timeField': 'time'},
+        'filter': None, 'dataFormat': 'json'})
+    for m in METRICS:
+        cfg = cfg.metric_add({'name': m['name'],
+                              'datasource': 'clusterbench',
+                              'filter': m.get('filter'),
+                              'breakdowns': m['breakdowns']})
+    mod_config.ConfigBackendLocal(rc_path).save(cfg.serialize())
+
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    ds = make_ds(datafile, idx)
+    ds.build(metrics, 'day')
+    nshards = 0
+    for root, dirs, files in os.walk(idx):
+        nshards += len(files)
+
+    socks = {m: os.path.join(tmpdir, 'dn-%s.sock' % m) for m in 'abc'}
+    topo_path = os.path.join(tmpdir, 'topo.json')
+    with open(topo_path, 'w') as f:
+        json.dump({
+            'epoch': 1, 'assign': 'hash',
+            'members': {m: {'endpoint': socks[m]} for m in 'abc'},
+            'partitions': [
+                {'id': 0, 'replicas': ['a', 'b']},
+                {'id': 1, 'replicas': ['b', 'c']},
+                {'id': 2, 'replicas': ['c', 'a']},
+            ],
+        }, f)
+
+    env = dict(os.environ, DRAGNET_CONFIG=rc_path,
+               DN_ROUTER_HEDGE_MS=hedge_ms,
+               DN_ROUTER_PROBE_MS='200',
+               DN_REMOTE_RETRIES='1', DN_REMOTE_BACKOFF_MS='5',
+               DN_REMOTE_CONNECT_TIMEOUT_S='2')
+    dn = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      'bin', 'dn.py')
+    req = {'op': 'query', 'ds': 'clusterbench', 'interval': 'day',
+           'config': rc_path,
+           'queryconfig': {
+               'breakdowns': [
+                   {'name': 'host', 'field': 'host'},
+                   {'name': 'latency', 'field': 'latency',
+                    'aggr': 'quantize'}],
+               'filter': {'eq': ['req.method', 'GET']}},
+           'opts': {}}
+
+    def spawn(args):
+        return subprocess.Popen([sys.executable, dn] + args, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    def wait_up(sock, proc):
+        deadline = time.monotonic() + 60
+        while not mod_lc.probe(socket_path=sock):
+            if time.monotonic() > deadline or proc.poll() is not None:
+                raise RuntimeError('serve daemon failed to start')
+            time.sleep(0.1)
+
+    def pctl(times):
+        times = sorted(times)
+        return (times[len(times) // 2],
+                times[min(len(times) - 1, int(len(times) * 0.95))])
+
+    def warm_leg(sock, reps):
+        rc0, _, out_b, err_b = mod_scl.request_bytes(sock, req,
+                                                     timeout_s=300)
+        if rc0 != 0:
+            raise RuntimeError('bench query failed: %s'
+                               % err_b.decode()[-300:])
+        times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            rc0, _, out_b, _ = mod_scl.request_bytes(sock, req,
+                                                     timeout_s=300)
+            times.append((time.monotonic() - t0) * 1000)
+            assert rc0 == 0
+        return pctl(times) + (out_b,)
+
+    procs = []
+    try:
+        # baseline: one resident server owning the whole tree
+        single_sock = os.path.join(tmpdir, 'dn-single.sock')
+        single = spawn(['serve', '--socket', single_sock])
+        procs.append(single)
+        wait_up(single_sock, single)
+        single_p50, single_p95, single_out = warm_leg(single_sock,
+                                                      warm_reps)
+        single.send_signal(signal.SIGTERM)
+        single.wait(timeout=60)
+
+        # the 3-member cluster, routed through member a
+        members = {}
+        for m in 'abc':
+            members[m] = spawn(['serve', '--socket', socks[m],
+                                '--cluster', topo_path,
+                                '--member', m])
+            procs.append(members[m])
+        for m in 'abc':
+            wait_up(socks[m], members[m])
+        cl_p50, cl_p95, cl_out = warm_leg(socks['a'], warm_reps)
+        output_match = cl_out == single_out
+
+        # failover: SIGKILL member b (primary of partition 1); every
+        # partition keeps a live replica, so bytes must still match
+        members['b'].kill()
+        members['b'].wait()
+        fo_p50, fo_p95, fo_out = warm_leg(socks['a'], warm_reps)
+        failover_match = fo_out == single_out
+
+        st = mod_scl.stats(socks['a'], timeout_s=30.0)
+        cl_sec = st.get('cluster') or {}
+        counters = cl_sec.get('counters') or {}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        shutil.rmtree(idx, ignore_errors=True)
+        os.unlink(datafile)
+
+    scatters = counters.get('scatters') or 0
+    hedges = counters.get('hedges_fired') or 0
+    return {
+        'cluster_records': n,
+        'cluster_shards': nshards,
+        'cluster_members': 3,
+        'cluster_partitions': 3,
+        'single_query_warm_p50_ms': round(single_p50, 2),
+        'single_query_warm_p95_ms': round(single_p95, 2),
+        'cluster_query_warm_p50_ms': round(cl_p50, 2),
+        'cluster_query_warm_p95_ms': round(cl_p95, 2),
+        'cluster_vs_single': round(cl_p50 / single_p50, 2)
+        if single_p50 else None,
+        'cluster_output_byte_identical': output_match,
+        'failover_query_p50_ms': round(fo_p50, 2),
+        'failover_query_p95_ms': round(fo_p95, 2),
+        'failover_added_p50_ms': round(fo_p50 - cl_p50, 2),
+        'failover_output_byte_identical': failover_match,
+        'cluster_failovers': counters.get('failovers'),
+        'cluster_scatters': scatters,
+        'cluster_hedges_fired': hedges,
+        'cluster_hedge_fire_rate': round(hedges / scatters, 3)
+        if scatters else None,
+        'cluster_hedges_won': counters.get('hedges_won'),
+        'cluster_degraded': counters.get('degraded'),
+    }
+
+
+def main_cluster():
+    """Cluster legs only (`make bench-cluster` / --cluster-only)."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_cluster_')
+    try:
+        cb = cluster_bench(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    sys.stderr.write(
+        'bench-cluster: %d shards over %d members; scatter-gather '
+        'p50 %.1fms p95 %.1fms vs single-server p50 %.1fms (%.2fx); '
+        'failover p50 %.1fms (+%.1fms, %s failovers); hedges fired '
+        '%s/%s scatters (rate %s); bytes identical %s / after kill '
+        '%s\n'
+        % (cb['cluster_shards'], cb['cluster_members'],
+           cb['cluster_query_warm_p50_ms'],
+           cb['cluster_query_warm_p95_ms'],
+           cb['single_query_warm_p50_ms'],
+           cb['cluster_vs_single'] or 0.0,
+           cb['failover_query_p50_ms'], cb['failover_added_p50_ms'],
+           cb['cluster_failovers'], cb['cluster_hedges_fired'],
+           cb['cluster_scatters'], cb['cluster_hedge_fire_rate'],
+           cb['cluster_output_byte_identical'],
+           cb['failover_output_byte_identical']))
+    print(json.dumps({
+        'metric': 'cluster_query_warm_p50_ms',
+        'value': cb['cluster_query_warm_p50_ms'],
+        'unit': 'ms',
+        'vs_baseline': cb['cluster_vs_single'],
+        'extra': cb,
+    }))
+
+
 def main_parse():
     """Parse-lane legs only (`make bench-parse` / --parse-only):
     host-record vs native vs vector vs device parse MB/s plus
@@ -1157,6 +1379,9 @@ def main():
     if '--serve-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'serve':
         return main_serve()
+    if '--cluster-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'cluster':
+        return main_cluster()
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)

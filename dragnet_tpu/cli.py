@@ -80,6 +80,11 @@ DN_OPTIONS = [
     # output is byte-pinned to the reference goldens; documented in
     # docs/performance.md).  Equivalent to DN_BUILD_THREADS for one run.
     (['build-threads'], 'string', None),
+    # `dn serve` cluster mode: --cluster=TOPOLOGY.json names the
+    # scatter-gather cluster map (defaults to DN_SERVE_TOPOLOGY when
+    # set) and --member=NAME this server's identity in it.  Not in
+    # USAGE_TEXT (byte-pinned); documented in docs/serving.md.
+    (['cluster'], 'string', None),
     (['counters'], 'bool', None),
     (['data-format'], 'string', 'json'),
     (['datasource'], 'string', None),
@@ -97,6 +102,7 @@ DN_OPTIONS = [
     # DN_IQ_STACK for one run: auto|0|1.
     (['iq-stack'], 'string', None),
     (['index-path'], 'string', None),
+    (['member'], 'string', None),
     # ingest parse-lane override (not in USAGE_TEXT: the usage output
     # is byte-pinned to the reference goldens; documented in
     # docs/performance.md).  Equivalent to DN_PARSE for one run:
@@ -901,29 +907,49 @@ def cmd_stats(ctx, argv):
 
 
 def cmd_serve(ctx, argv):
-    """`dn serve --socket PATH | --port N [--pidfile P] [--validate]`:
-    the resident query server (serve/server.py).  Not in USAGE_TEXT —
-    the usage output is byte-pinned to the reference goldens;
-    documented in docs/serving.md."""
+    """`dn serve --socket PATH | --port N [--pidfile P]
+    [--cluster TOPOLOGY.json --member NAME] [--validate]`: the
+    resident query server (serve/server.py), optionally as a member
+    of a scatter-gather cluster (serve/topology.py, serve/router.py).
+    Not in USAGE_TEXT — the usage output is byte-pinned to the
+    reference goldens; documented in docs/serving.md."""
+    import os
     opts = dn_parse_args(argv, ['socket', 'port', 'pidfile',
-                                'validate'])
+                                'cluster', 'member', 'validate'])
     check_arg_count(opts, 0)
 
     conf = mod_config.serve_config()
     if isinstance(conf, DNError):
         fatal(conf)
-    # the retry and fault-injection knobs share the fail-fast
-    # contract: a malformed value is caught here (and by --validate),
-    # not at the first request that needs it
+    # the retry, router, and fault-injection knobs share the
+    # fail-fast contract: a malformed value is caught here (and by
+    # --validate), not at the first request that needs it
     remote_conf = mod_config.remote_config()
     if isinstance(remote_conf, DNError):
         fatal(remote_conf)
+    router_conf = mod_config.router_config()
+    if isinstance(router_conf, DNError):
+        fatal(router_conf)
     faults_conf = mod_config.faults_config()
     if isinstance(faults_conf, DNError):
         fatal(faults_conf)
     obs_conf = mod_config.obs_config()
     if isinstance(obs_conf, DNError):
         fatal(obs_conf)
+
+    cluster = opts.cluster or os.environ.get('DN_SERVE_TOPOLOGY') \
+        or None
+    if (cluster is None) != (opts.member is None):
+        raise UsageError('"--cluster" and "--member" must be used '
+                         'together')
+    topo = None
+    if cluster is not None:
+        from .serve import topology as mod_topology
+        try:
+            topo = mod_topology.load_topology(cluster,
+                                              member=opts.member)
+        except DNError as e:
+            fatal(e)
 
     port = None
     if opts.port is not None:
@@ -958,6 +984,23 @@ def cmd_serve(ctx, argv):
             % (obs_conf['trace'] or 'off',
                obs_conf['slow_ms'] if obs_conf['slow_ms'] is not None
                else 'off', len(obs_conf['buckets'])))
+        sys.stdout.write(
+            'router config ok: probe_ms=%d failures=%d '
+            'cooldown_ms=%d hedge_ms=%d fetch_timeout_s=%d '
+            'partial=%s\n'
+            % (router_conf['probe_ms'], router_conf['failures'],
+               router_conf['cooldown_ms'], router_conf['hedge_ms'],
+               router_conf['fetch_timeout_s'],
+               router_conf['partial']))
+        if topo is not None:
+            sys.stdout.write(
+                'cluster topology ok: member=%s epoch=%d assign=%s '
+                'members=%d partitions=%d (owns: %s)\n'
+                % (opts.member, topo.epoch, topo.assign,
+                   len(topo.members), len(topo.partitions),
+                   ','.join(str(p)
+                            for p in topo.partitions_of(opts.member))
+                   or 'none'))
         sites = faults_conf['sites']
         if sites:
             sys.stdout.write(
@@ -969,7 +1012,10 @@ def cmd_serve(ctx, argv):
     from .serve import server as mod_server
     try:
         return mod_server.serve_main(socket_path=opts.socket,
-                                     port=port, pidfile=opts.pidfile)
+                                     port=port, pidfile=opts.pidfile,
+                                     cluster=topo,
+                                     member=opts.member,
+                                     router_conf=router_conf)
     except DNError as e:
         fatal(e)
 

@@ -399,6 +399,68 @@ def remote_config(env=None):
     return rv
 
 
+# --- scatter-gather router knobs (DN_ROUTER_*) ------------------------
+#
+# Same contract as the serve/remote knobs: parsed and validated in one
+# place (serve/router.py consumes them; `dn serve --validate` checks
+# them up front).  Each entry: (env name, kind, default, min).
+
+_ROUTER_KNOBS = [
+    # member health-probe cadence (the breaker's recovery signal)
+    ('DN_ROUTER_PROBE_MS', 'int', 500, 50),
+    # consecutive probe/dispatch failures before a member's circuit
+    # breaker opens
+    ('DN_ROUTER_FAILURES', 'int', 3, 1),
+    # how long an open breaker waits before allowing one half-open
+    # trial request
+    ('DN_ROUTER_COOLDOWN_MS', 'int', 2000, 1),
+    # hedged reads: minimum delay before firing a duplicate partial
+    # at the next replica (the effective delay is max(this, observed
+    # p95 partial latency)); 0 disables hedging
+    ('DN_ROUTER_HEDGE_MS', 'int', 0, 0),
+    # per-partial-fetch wall-clock bound (a dead-but-accepting member
+    # must cost the router a bounded wait, never a hang)
+    ('DN_ROUTER_FETCH_TIMEOUT_S', 'int', 60, 1),
+]
+
+
+def router_config(env=None):
+    """The resolved DN_ROUTER_* knob dict (keys: probe_ms, failures,
+    cooldown_ms, hedge_ms, fetch_timeout_s, partial), or DNError on
+    the first malformed value.  DN_ROUTER_PARTIAL picks the response
+    contract when every replica of a partition is down: 'error' (the
+    default — a clean retryable DNError naming the missing
+    partitions) or 'allow' (a partial=true response merging the live
+    partitions, missing ids named in the header)."""
+    if env is None:
+        env = os.environ
+    rv = {}
+    for name, kind, default, minimum in _ROUTER_KNOBS:
+        key = name[len('DN_ROUTER_'):].lower()
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        if value < minimum:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        rv[key] = value
+    raw = env.get('DN_ROUTER_PARTIAL')
+    if raw is None or raw == '':
+        rv['partial'] = 'error'
+    elif raw in ('error', 'allow'):
+        rv['partial'] = raw
+    else:
+        return DNError('DN_ROUTER_PARTIAL: expected "error" or '
+                       '"allow", got "%s"' % raw)
+    return rv
+
+
 # --- observability knobs (DN_TRACE / DN_SLOW_MS / DN_METRICS_BUCKETS) -
 #
 # Same contract as the serve/remote knobs: parsed and validated in one

@@ -963,10 +963,17 @@ class DatasourceFile(object):
         from . import index_query_mt as mod_iqmt
         return mod_iqmt.cached_find_walk(root, pipeline)
 
-    def query(self, query, interval, dry_run=False):
-        """Query the indexes.  (reference:
-        lib/datasource-file.js:573-691)"""
-        pipeline = Pipeline()
+    def index_query_paths(self, query, interval, pipeline):
+        """Enumerate the shard files an index query over `query` x
+        `interval` would read: argument checks, the crash-recovery
+        sweep, the (possibly memoized) tree walk, and the
+        journal/tmp/quarantine litter filter — everything up to (not
+        including) time-range pruning.  Returns (root, timeformat,
+        files) with files as (path, statbuf) pairs in find order.
+        Shared by query() below and the cluster partial-query
+        executor (serve/router.py), so a member's partition-filtered
+        shard set is drawn from the IDENTICAL walk a single-process
+        query performs."""
         error = self.check_time_args(query.qc_after, query.qc_before)
         if error is None:
             error = self.check_index_args(interval, True, False)
@@ -999,6 +1006,14 @@ class DatasourceFile(object):
         # stay out of the shard set
         files = [(p, st) for p, st in files
                  if not mod_journal.is_index_litter(p)]
+        return root, timeformat, files
+
+    def query(self, query, interval, dry_run=False):
+        """Query the indexes.  (reference:
+        lib/datasource-file.js:573-691)"""
+        pipeline = Pipeline()
+        root, timeformat, files = self.index_query_paths(
+            query, interval, pipeline)
 
         if dry_run:
             return ScanResult(pipeline,

@@ -61,6 +61,9 @@ SITES = (
     'client.send',      # remote client: request send
     'client.recv',      # remote client: response header/payload read
     'device.probe',     # device backend probe (device_scan)
+    'router.dispatch',  # scatter-gather: per-partition dispatch
+    'router.merge',     # scatter-gather: partial-aggregate merge
+    'member.health',    # dn serve: the health op a router probes
 )
 
 

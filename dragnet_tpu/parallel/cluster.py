@@ -274,6 +274,20 @@ class DatasourceCluster(datasource_file.DatasourceFile):
             # force it (device = structural scan staged through jax)
             'parse_mode': parse_mode(),
         }
+        # scatter-gather serve topology (serve/topology.py): when the
+        # environment names a cluster map, the plan reports the member/
+        # partition layout resident `dn serve` processes would serve
+        # under.  Informational only — a broken topology file must not
+        # fail a dry run, so load errors report in-plan instead.
+        topo_path = os.environ.get('DN_SERVE_TOPOLOGY')
+        if topo_path:
+            from ..serve import topology as mod_topology
+            try:
+                plan['serve_topology'] = \
+                    mod_topology.load_topology(topo_path).summary()
+            except DNError as e:
+                plan['serve_topology'] = {'path': topo_path,
+                                          'error': str(e)}
         # informational only — must never pay backend initialization
         # (over a tunneled device plugin the first probe can block for
         # minutes; a dry run does no device execution).  Multi-process

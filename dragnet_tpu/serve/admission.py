@@ -296,7 +296,7 @@ def compute_key(req, config_ident):
     interval, dry-run, plus the config file's identity so an edited
     datasource definition never shares with its predecessor) and
     nothing that only affects output formatting."""
-    if req.get('op') not in ('scan', 'query'):
+    if req.get('op') not in ('scan', 'query', 'query_partial'):
         return None              # builds and debug ops never coalesce
     doc = {
         'op': req.get('op'),
@@ -306,4 +306,9 @@ def compute_key(req, config_ident):
         'interval': req.get('interval'),
         'dry_run': bool((req.get('opts') or {}).get('dry_run')),
     }
+    if req.get('op') == 'query_partial':
+        # partition-scoped partials only share when they cover the
+        # same partitions under the same topology generation
+        doc['partitions'] = sorted(req.get('partitions') or [])
+        doc['epoch'] = req.get('epoch')
     return json.dumps(doc, sort_keys=True, separators=(',', ':'))

@@ -267,9 +267,10 @@ def request(remote, req, timeout_s=None):
 def request_bytes(remote, req, timeout_s=60.0, retry=False):
     """request() for harnesses and probes: returns (rc, header,
     stdout_bytes, stderr_bytes) instead of writing through the
-    process streams.  Probes default to a single attempt (a liveness
-    check must not mask a dead server behind retries); pass
-    retry=True for the armored path."""
+    process streams.  Defaults to a single attempt; pass retry=True
+    for the armored _exchange_with_retry path (health/stats probes
+    do — one transient accept flap must not read as a dead
+    server)."""
     def buffer_up(header, f):
         out = b''.join(_read_exact(f, header.get('nout', 0)))
         err = b''.join(_read_exact(f, header.get('nerr', 0)))
@@ -306,19 +307,27 @@ def run_or_fallback(remote, req):
 
 
 def stats(remote, timeout_s=5.0):
-    """Fetch and parse the server's /stats document (bench + tests)."""
+    """Fetch and parse the server's /stats document (bench + tests).
+    Rides the _exchange_with_retry backoff path: a transient accept
+    flap must not read as a dead server."""
     rc, header, out, err = request_bytes(remote, {'op': 'stats'},
-                                         timeout_s=timeout_s)
+                                         timeout_s=timeout_s,
+                                         retry=True)
     return json.loads(out.decode('utf-8'))
 
 
 def health(remote, timeout_s=5.0):
-    """One un-retried health probe: the parsed health document, or
-    the error string — what a scatter-gather router polls to pick
-    live replicas."""
+    """A health probe: the parsed health document, or {'ok': False,
+    'error': ...} — what a scatter-gather router polls to pick live
+    replicas.  Probes ride the _exchange_with_retry backoff path: a
+    single-shot probe would turn one transient accept failure into a
+    'dead member' verdict — exactly wrong under a circuit breaker,
+    which needs DN_ROUTER_FAILURES *post-retry* verdicts before it
+    opens."""
     try:
         rc, header, out, err = request_bytes(
-            remote, {'op': 'health'}, timeout_s=timeout_s)
+            remote, {'op': 'health'}, timeout_s=timeout_s,
+            retry=True)
         return json.loads(out.decode('utf-8'))
     except (OSError, ValueError, DNError) as e:
         return {'ok': False, 'error': str(e)}

@@ -1,0 +1,652 @@
+"""Scatter-gather query routing for a `dn serve` cluster.
+
+Any cluster member can be the router for an incoming index query: it
+fans one partition-scoped partial query (`query_partial`) to a live
+replica of every partition in the topology, merges the partial
+aggregates through the Aggregator key-items wire format, and formats
+the merged result through the unmodified CLI output layer — so a
+routed query's RESULT bytes are identical to a single-process run.
+(`--counters` debug output is explicitly outside that contract: it
+renders pipeline stages, and the router's merge pipeline is not the
+single-process find/walk pipeline — each member ran its own walk.)
+
+Byte-identity is structural, not hopeful: final output order depends
+on the FIRST-OCCURRENCE order of string-like group keys across the
+whole shard set (aggr.js_key_order), so partials travel as
+PER-SHARD key-item lists (each member answers for its shards in find
+order) and the router merges every shard — across all partitions —
+in global find order (the path-component sort below).  The merge loop
+is the same write_key replay `datasource_file.query` runs for its own
+shard fan-in.
+
+Failure-first design (the headline of this layer):
+
+* Per-member circuit breakers (closed -> open after
+  DN_ROUTER_FAILURES consecutive failures -> half-open one trial
+  after DN_ROUTER_COOLDOWN_MS), fed by both a background health
+  prober (the PR 6 `health` op, DN_ROUTER_PROBE_MS cadence) and live
+  dispatch outcomes.
+* Automatic failover: a partial that fails on one replica
+  (connect/transport errors, retryable rejections, epoch mismatch)
+  moves to the next-ranked replica.  Replica ranking demotes DRAINING
+  members before their socket dies and open-breaker members to
+  last-resort (they are still dialed when nothing better exists — the
+  breaker must never turn a blip into a guaranteed outage).
+* Hedged reads: when a partial is slower than the observed p95
+  (floored at DN_ROUTER_HEDGE_MS; 0 disables), the router fires a
+  duplicate at the next replica and keeps whichever answers first;
+  fired/won/wasted counts are accounted.
+* Clean degraded results: when EVERY replica of a partition is down,
+  DN_ROUTER_PARTIAL picks the contract — 'error' raises a retryable
+  DNError naming the missing partitions; 'allow' merges the live
+  partitions and names the missing ones in the response header.
+  Never a hang (DN_ROUTER_FETCH_TIMEOUT_S bounds each fetch), never
+  a traceback, never silently short bytes.
+
+Every decision lands in the obs layer: router_* counters and the
+router_partial_ms histogram (which also feeds the hedge delay),
+router.scatter/router.partial/router.merge spans, and the /stats
+`cluster` section (serve/server.py).
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+
+from ..errors import DNError
+from .. import config as mod_config
+from .. import faults as mod_faults
+from .. import vpipe as mod_vpipe
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+
+class RouterPartitionError(DNError):
+    """Every replica of >= 1 partition is down and DN_ROUTER_PARTIAL
+    is 'error': a clean, retryable degraded response naming the
+    missing partitions (the `missing_partitions` attribute rides into
+    the response header)."""
+
+    def __init__(self, missing, detail):
+        super(RouterPartitionError, self).__init__(
+            'cluster partition(s) unavailable: %s (%s)'
+            % (','.join(str(p) for p in missing), detail))
+        self.missing_partitions = list(missing)
+        self.retryable = True
+
+
+class _BreakerOpen(Exception):
+    """Internal: a dial was suppressed by an open breaker."""
+
+
+# -- circuit breaker --------------------------------------------------------
+
+class Breaker(object):
+    """Per-member circuit breaker: CLOSED (healthy) -> OPEN after
+    `failures` consecutive failures -> HALF_OPEN one trial after
+    `cooldown_ms` -> CLOSED on trial success / back to OPEN on trial
+    failure.  allow() consumes the half-open trial; record_success /
+    record_failure feed it from probes and live dispatches alike."""
+
+    CLOSED, OPEN, HALF_OPEN = 'closed', 'open', 'half-open'
+
+    def __init__(self, failures, cooldown_ms, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.failures_threshold = failures
+        self.cooldown_s = cooldown_ms / 1000.0
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self._opened_at = None
+        self._trial_inflight = False
+        self.transitions = {self.CLOSED: 0, self.OPEN: 0,
+                            self.HALF_OPEN: 0}
+
+    def _to(self, state):
+        self.state = state
+        self.transitions[state] += 1
+
+    def allow(self):
+        """May a request be sent to this member right now?"""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                if self._clock() - self._opened_at >= self.cooldown_s:
+                    self._to(self.HALF_OPEN)
+                    self._trial_inflight = True
+                    return True
+                return False
+            # HALF_OPEN: exactly one trial in flight at a time
+            if self._trial_inflight:
+                return False
+            self._trial_inflight = True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            self.consecutive_failures = 0
+            self._trial_inflight = False
+            if self.state != self.CLOSED:
+                self._to(self.CLOSED)
+
+    def record_failure(self):
+        with self._lock:
+            self.consecutive_failures += 1
+            self._trial_inflight = False
+            if self.state == self.HALF_OPEN:
+                self._opened_at = self._clock()
+                self._to(self.OPEN)
+            elif self.state == self.CLOSED and \
+                    self.consecutive_failures >= \
+                    self.failures_threshold:
+                self._opened_at = self._clock()
+                self._to(self.OPEN)
+
+    def snapshot(self):
+        with self._lock:
+            return {'state': self.state,
+                    'consecutive_failures': self.consecutive_failures,
+                    'transitions': dict(self.transitions)}
+
+
+class MemberState(object):
+    """What the router knows about one member: endpoint, breaker, and
+    the last health-probe verdict."""
+
+    def __init__(self, name, endpoint, breaker):
+        self.name = name
+        self.endpoint = endpoint
+        self.breaker = breaker
+        self.lock = threading.Lock()
+        self.draining = False
+        self.last_ok = None        # monotonic of last good signal
+
+    def note_health(self, doc):
+        ok = bool(doc.get('ok'))
+        with self.lock:
+            self.draining = bool(doc.get('draining'))
+            if ok:
+                self.last_ok = time.monotonic()
+        if ok:
+            self.breaker.record_success()
+        else:
+            self.breaker.record_failure()
+
+    def snapshot(self):
+        with self.lock:
+            draining = self.draining
+            last_ok = self.last_ok
+        snap = self.breaker.snapshot()
+        snap.update({'endpoint': self.endpoint, 'draining': draining,
+                     'last_ok_age_s':
+                     round(time.monotonic() - last_ok, 3)
+                     if last_ok is not None else None})
+        return snap
+
+
+# -- member-side partial execution ------------------------------------------
+
+def partial_query(ds, query, interval, topology, partition_ids):
+    """Execute an index query over THIS member's slice of the shard
+    set: the identical enumerate/sweep/litter-filter/prune walk a
+    single-process query performs (datasource_file.index_query_paths),
+    restricted to the shards `partition_ids` own, each shard's
+    aggregate exported as key items in find order.  Returns
+    [[relpath, [[keys..., ], weight], ...], ...] — the JSON wire shape
+    of the `query_partial` op."""
+    from .. import index_query_mt as mod_iqmt
+    from ..vpipe import Pipeline
+    pipeline = Pipeline()
+    root, timeformat, files = ds.index_query_paths(query, interval,
+                                                   pipeline)
+    paths = [p for p, st in files]
+    paths, _ = mod_iqmt.prune_shards(paths, timeformat,
+                                     query.qc_after, query.qc_before)
+    want = set(partition_ids)
+    paths = [p for p in paths
+             if topology.partition_of(p, timeformat) in want]
+    mod_vpipe.counter_bump('cluster partial shards', len(paths))
+    indexroot = ds.ds_indexpath
+    shards = []
+    state = {'i': 0}
+
+    def on_items(items):
+        # run_shard_queries reports once per shard in `paths` order
+        path = paths[state['i']]
+        state['i'] += 1
+        shards.append([os.path.relpath(path, indexroot),
+                       [[list(k), w] for k, w in items]])
+
+    mod_iqmt.run_shard_queries(paths, query, mod_iqmt.iq_threads(),
+                               on_items)
+    return shards
+
+
+# -- the router -------------------------------------------------------------
+
+class Router(object):
+    """The scatter-gather executor one cluster member runs.
+
+    `local_exec(partition_ids, req)` is the server-provided callable
+    that executes a partial for partitions THIS member owns without
+    dialing itself (admission slot + tree read-lock inside) — routing
+    through our own socket could deadlock a full admission queue.
+    `self_draining()` reports the local server's drain state so the
+    self replica demotes exactly like a remote draining member."""
+
+    def __init__(self, topology, member, conf=None, local_exec=None,
+                 self_draining=None):
+        if conf is None:
+            conf = mod_config.router_config()
+        if isinstance(conf, DNError):
+            raise conf
+        self.topo = topology
+        self.member = member
+        self.conf = conf
+        self.local_exec = local_exec
+        self.self_draining = self_draining or (lambda: False)
+        self.states = {}
+        for name in topology.member_names():
+            self.states[name] = MemberState(
+                name, topology.endpoint(name),
+                Breaker(conf['failures'], conf['cooldown_ms']))
+        self._stop = threading.Event()
+        self._probers = None
+        self._lock = threading.Lock()
+        self._counters = {'scatters': 0, 'partials_local': 0,
+                          'partials_remote': 0, 'failovers': 0,
+                          'hedges_fired': 0, 'hedges_won': 0,
+                          'hedges_wasted': 0, 'degraded': 0,
+                          'partial_responses': 0,
+                          'breaker_skips': 0,
+                          'breaker_forced_dials': 0}
+        # the hedge-delay source: observed partial latencies (also
+        # exported through the typed registry as router_partial_ms)
+        self._latency = obs_metrics.Histogram()
+        self._latency_lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        if self._probers is None:
+            # ONE prober thread per member: a probe of a hard-down
+            # TCP member can block for the client's full retry
+            # budget, and a shared serial sweep would starve every
+            # other member's breaker/draining freshness of exactly
+            # the signal DN_ROUTER_PROBE_MS promises
+            self._probers = []
+            for name in self.topo.member_names():
+                t = threading.Thread(
+                    target=self._probe_loop, args=(name,),
+                    name='dn-router-probe-%s' % name, daemon=True)
+                t.start()
+                self._probers.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._probers or []:
+            t.join(2.0)
+        self._probers = None
+
+    # -- health probing ---------------------------------------------------
+
+    def _probe_loop(self, name):
+        from . import client as mod_client
+        period = self.conf['probe_ms'] / 1000.0
+        st = self.states[name]
+        while not self._stop.wait(period):
+            if name == self.member:
+                st.note_health({'ok': True,
+                                'draining': self.self_draining()})
+                continue
+            doc = mod_client.health(st.endpoint,
+                                    timeout_s=min(
+                                        5.0, period * 4 + 1.0))
+            if self._stop.is_set():
+                return
+            st.note_health(doc)
+
+    def probe_once(self):
+        """One synchronous probe sweep (tests, and a cold router that
+        wants member state before its first scatter)."""
+        from . import client as mod_client
+        for name, st in self.states.items():
+            if name == self.member:
+                st.note_health({'ok': True,
+                                'draining': self.self_draining()})
+            else:
+                st.note_health(mod_client.health(st.endpoint,
+                                                 timeout_s=5.0))
+
+    # -- accounting -------------------------------------------------------
+
+    def _bump(self, name, n=1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+        obs_metrics.inc('router_%s_total' % name, n)
+
+    def _observe_latency(self, ms):
+        with self._latency_lock:
+            self._latency.observe(ms)
+        obs_metrics.observe('router_partial_ms', ms)
+
+    def _hedge_delay_s(self):
+        """The hedge trigger: the larger of DN_ROUTER_HEDGE_MS and
+        the observed p95 partial latency (a hedge should chase the
+        tail, not the median); None when hedging is disabled."""
+        floor_ms = self.conf['hedge_ms']
+        if floor_ms <= 0:
+            return None
+        with self._latency_lock:
+            p95 = self._latency.quantile(0.95) \
+                if self._latency.total >= 8 else None
+        return max(floor_ms, p95 or 0.0) / 1000.0
+
+    def stats_doc(self):
+        with self._lock:
+            counters = dict(self._counters)
+        return {
+            'member': self.member,
+            'epoch': self.topo.epoch,
+            'assign': self.topo.assign,
+            'partitions_owned': self.topo.partitions_of(self.member),
+            'partitions': len(self.topo.partitions),
+            'counters': counters,
+            'members': {name: st.snapshot()
+                        for name, st in self.states.items()},
+        }
+
+    # -- replica ranking --------------------------------------------------
+
+    def _rank(self, replicas):
+        """Dispatch preference: healthy members first (self preferred
+        — a local partial never pays the socket), draining members
+        demoted, open-breaker members last-resort.  Returns the full
+        list — a last-resort member is still better than a degraded
+        response."""
+        def score(name):
+            st = self.states[name]
+            snap = st.breaker.snapshot()
+            with st.lock:
+                draining = st.draining
+            if name == self.member:
+                draining = draining or self.self_draining()
+            penalty = 0
+            if draining:
+                penalty += 1
+            if snap['state'] == Breaker.OPEN:
+                penalty += 2
+            return (penalty, 0 if name == self.member else 1,
+                    replicas.index(name))
+        return sorted(replicas, key=score)
+
+    # -- partial fetch ----------------------------------------------------
+
+    def _fetch_one(self, name, pid, partial_req, timeout_s,
+                   force=False):
+        """One partial attempt at one member; returns the shard list
+        or raises (DNError for member-reported failures, OSError/
+        ValueError for transport, _BreakerOpen for a suppressed
+        dial).  Breaker accounting happens here.  `force` bypasses
+        the breaker gate (outcomes still feed it): the exhaustion
+        path force-dials suppressed replicas before degrading — an
+        open breaker must never turn a blip into a guaranteed
+        outage."""
+        from . import client as mod_client
+        t0 = time.monotonic()
+        if name == self.member:
+            with obs_trace.span('router.partial', member=name,
+                                partition=pid, local=True):
+                shards = self.local_exec(partial_req['partitions'],
+                                         partial_req)
+            self._bump('partials_local')
+            self._observe_latency((time.monotonic() - t0) * 1000.0)
+            return shards
+        st = self.states[name]
+        if not force and not st.breaker.allow():
+            self._bump('breaker_skips')
+            raise _BreakerOpen(name)
+        try:
+            with obs_trace.span('router.partial', member=name,
+                                partition=pid):
+                rc, header, out, err = mod_client.request_bytes(
+                    st.endpoint, partial_req, timeout_s=timeout_s)
+        except (OSError, ValueError, DNError) as e:
+            st.breaker.record_failure()
+            raise DNError('member "%s"' % name,
+                          cause=DNError(str(e)))
+        if rc != 0:
+            # the member answered: it is alive (busy/draining/epoch
+            # mismatch are retryable rejections, not breaker food)
+            if header.get('retryable'):
+                st.breaker.record_success()
+            else:
+                st.breaker.record_failure()
+            msg = err.decode('utf-8', 'replace').strip() or \
+                'partial failed'
+            raise DNError('member "%s": %s' % (name, msg))
+        st.breaker.record_success()
+        try:
+            doc = json.loads(out.decode('utf-8'))
+            shards = doc['shards']
+        except (ValueError, KeyError, UnicodeDecodeError) as e:
+            raise DNError('member "%s": malformed partial response'
+                          % name, cause=DNError(str(e)))
+        self._bump('partials_remote')
+        self._observe_latency((time.monotonic() - t0) * 1000.0)
+        return shards
+
+    def _fetch_partition(self, pid, partial_req, scope):
+        """Fetch one partition's partial with failover + hedging.
+        Returns the shard list; raises DNError when every replica
+        failed."""
+        with mod_vpipe.adopt_scope(scope):
+            mod_faults.fire('router.dispatch')
+            ranked = self._rank(self.topo.replicas(pid))
+            timeout_s = self.conf['fetch_timeout_s']
+            resultq = queue.Queue()
+            launched = []
+
+            def launch(name, role, force=False):
+                launched.append(name)
+
+                def body():
+                    with mod_vpipe.adopt_scope(scope):
+                        try:
+                            resultq.put(
+                                (role, name, True,
+                                 self._fetch_one(name, pid,
+                                                 partial_req,
+                                                 timeout_s,
+                                                 force=force)))
+                        except _BreakerOpen:
+                            resultq.put((role, name, False, None))
+                        except (DNError, Exception) as e:
+                            resultq.put((role, name, False, e))
+                t = threading.Thread(
+                    target=body, daemon=True,
+                    name='dn-router-p%s-%s' % (pid, name))
+                t.start()
+
+            errors = []
+            skipped = []
+            hedge_delay = self._hedge_delay_s()
+            hedged = False
+            forced = False
+            outstanding = 1
+            nxt = 1
+            launch(ranked[0], 'primary')
+            deadline = time.monotonic() + timeout_s * len(ranked) + 5
+            while outstanding > 0:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    break
+                if not hedged and hedge_delay is not None and \
+                        nxt < len(ranked):
+                    wait = min(wait, hedge_delay)
+                try:
+                    role, name, ok, value = resultq.get(timeout=wait)
+                except queue.Empty:
+                    if not hedged and hedge_delay is not None and \
+                            nxt < len(ranked):
+                        # the in-flight partial is slower than the
+                        # tail: duplicate it at the next replica and
+                        # keep whichever answers first
+                        hedged = True
+                        self._bump('hedges_fired')
+                        obs_trace.event('router.hedge',
+                                        partition=pid,
+                                        member=ranked[nxt])
+                        launch(ranked[nxt], 'hedge')
+                        nxt += 1
+                        outstanding += 1
+                    continue
+                outstanding -= 1
+                if ok:
+                    if hedged:
+                        # the loser is abandoned; its eventual result
+                        # is discarded — account the cancellation
+                        if role == 'hedge':
+                            self._bump('hedges_won')
+                        else:
+                            self._bump('hedges_wasted')
+                    return value
+                if value is not None:
+                    errors.append(value)
+                else:
+                    skipped.append(name)
+                if nxt < len(ranked):
+                    self._bump('failovers')
+                    obs_trace.event('router.failover', partition=pid,
+                                    to=ranked[nxt])
+                    launch(ranked[nxt], 'failover')
+                    nxt += 1
+                    outstanding += 1
+                elif outstanding == 0 and skipped and not forced:
+                    # every remaining candidate was suppressed by an
+                    # open breaker: before degrading, force one real
+                    # dial at each — a breaker still inside its
+                    # cooldown must never turn a transient blip into
+                    # a guaranteed outage when it holds the only
+                    # live replica
+                    forced = True
+                    for skip_name in skipped:
+                        self._bump('breaker_forced_dials')
+                        obs_trace.event('router.breaker_force',
+                                        partition=pid,
+                                        member=skip_name)
+                        launch(skip_name, 'forced', force=True)
+                        outstanding += 1
+            detail = '; '.join(
+                getattr(e, 'message', None) or str(e)
+                for e in errors[-2:]) or 'no replica reachable'
+            raise DNError('partition %d: all replicas failed '
+                          '(tried %s): %s'
+                          % (pid, ','.join(launched), detail))
+
+    # -- scatter-gather ---------------------------------------------------
+
+    def scatter(self, ds, dsname, query, interval, req):
+        """Fan `req` (an index query) across every partition and
+        merge.  Returns (ScanResult, missing_partition_ids); raises
+        RouterPartitionError in DN_ROUTER_PARTIAL=error mode when any
+        partition has no live replica."""
+        from ..aggr import Aggregator
+        from ..datasource_file import ScanResult
+        from ..vpipe import Pipeline
+
+        self._bump('scatters')
+        pids = self.topo.partition_ids()
+        partial_req = {
+            'op': 'query_partial', 'ds': dsname,
+            'config': req.get('config'),
+            'interval': interval,
+            'queryconfig': req.get('queryconfig'),
+            'epoch': self.topo.epoch,
+        }
+        scope = mod_vpipe.current_scope()
+        results = {}
+        failures = {}
+        threads = []
+        lock = threading.Lock()
+
+        def fetch(pid):
+            preq = dict(partial_req, partitions=[pid])
+            try:
+                shards = self._fetch_partition(pid, preq, scope)
+                with lock:
+                    results[pid] = shards
+            except DNError as e:
+                with lock:
+                    failures[pid] = e
+            except Exception as e:
+                # a partition must NEVER drop out silently: any
+                # non-DNError bug in the fetch path becomes a named
+                # failure (degraded response), not a short merge
+                with lock:
+                    failures[pid] = DNError(
+                        'partition %d: internal fetch error: %r'
+                        % (pid, e))
+
+        with obs_trace.span('router.scatter', partitions=len(pids)):
+            for pid in pids:
+                t = threading.Thread(target=fetch, args=(pid,),
+                                     daemon=True,
+                                     name='dn-scatter-%s' % pid)
+                threads.append(t)
+                t.start()
+            for t in threads:
+                t.join()
+
+        missing = sorted(failures)
+        if missing:
+            self._bump('degraded')
+            detail = '; '.join(
+                failures[p].message for p in missing[:2])
+            if self.conf['partial'] == 'error':
+                raise RouterPartitionError(missing, detail)
+            self._bump('partial_responses')
+
+        # merge in GLOBAL find order: every member reported its shards
+        # in its own find order; the path-component sort reproduces
+        # the single-process walk order across partitions, so string
+        # keys first-occur in the identical order
+        pipeline = Pipeline()
+        index_list = pipeline.stage('Index List')
+        aggr = Aggregator(query,
+                          stage=pipeline.stage(
+                              'Index Result Aggregator'))
+        all_shards = []
+        for pid in sorted(results):
+            all_shards.extend(results[pid])
+        all_shards.sort(key=lambda s: tuple(s[0].split('/')))
+        with obs_trace.span('router.merge', shards=len(all_shards)):
+            mod_faults.fire('router.merge')
+            seen = set()
+            aggr_stage = aggr.stage
+            for relpath, items in all_shards:
+                if relpath in seen:
+                    # partitions are disjoint by construction; a
+                    # shard arriving twice means mismatched topologies
+                    # slipped past the epoch gate — refuse to
+                    # double-count
+                    raise DNError('cluster merge: shard "%s" '
+                                  'reported by two partitions '
+                                  '(topology mismatch?)' % relpath)
+                seen.add(relpath)
+                npts = len(items)
+                if npts == 0:
+                    continue
+                index_list.bump('ninputs', npts)
+                index_list.bump('noutputs', npts)
+                aggr_stage.bump('ninputs', npts)
+                aggr.merge_key_items([(tuple(k), w)
+                                      for k, w in items])
+        index_list.bump_hidden('index shards queried',
+                               len(all_shards))
+        return (ScanResult(pipeline, points=aggr.points(),
+                           query=query), missing)

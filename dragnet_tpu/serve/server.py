@@ -222,7 +222,8 @@ def device_engaged(counters):
 
 class DnServer(object):
     def __init__(self, socket_path=None, port=None, host='127.0.0.1',
-                 conf=None, pidfile=None):
+                 conf=None, pidfile=None, cluster=None, member=None,
+                 router_conf=None):
         if conf is None:
             conf = mod_config.serve_config()
         if isinstance(conf, DNError):
@@ -230,6 +231,18 @@ class DnServer(object):
         assert (socket_path is None) != (port is None), \
             'exactly one of socket_path/port'
         self.conf = conf
+        # cluster mode (`--cluster=TOPOLOGY.json --member=NAME`): this
+        # server owns its partitions of the index tree and acts as
+        # scatter-gather router for incoming queries (serve/router.py)
+        self.cluster = cluster
+        self.member = member
+        self.router = None
+        if cluster is not None:
+            from . import router as mod_router
+            self.router = mod_router.Router(
+                cluster, member, conf=router_conf,
+                local_exec=self._local_partial,
+                self_draining=lambda: self.draining)
         self.socket_path = socket_path
         self.port = port
         self.host = host
@@ -291,8 +304,11 @@ class DnServer(object):
         self.running = True
         _SERVER_LEAKS.track(self)
         self._hook = mod_lifecycle.install_writer_invalidation()
+        if self.router is not None:
+            self.router.start()
         self.log.info('listening',
                       socket=self.socket_path, port=self.bound_port,
+                      member=self.member,
                       max_inflight=self.conf['max_inflight'])
 
     def serve_forever(self):
@@ -356,6 +372,8 @@ class DnServer(object):
         leftover = sum(1 for t in workers if t.is_alive())
         if leftover:
             self.log.warn('drain grace expired', abandoned=leftover)
+        if self.router is not None:
+            self.router.stop()
         # flush warm state cleanly: cached shard handles hold open
         # mmaps / sqlite connections
         mod_iqmt.shard_cache_clear()
@@ -417,6 +435,10 @@ class DnServer(object):
             # p50/p90/p99 and cumulative buckets
             'metrics': obs_export.stats_section(counters=counters),
         }
+        if self.router is not None:
+            # scatter-gather observability: per-member breaker
+            # states, failover/hedge/degraded counters (router.py)
+            doc['cluster'] = self.router.stats_doc()
         try:
             from ..device_scan import _audition_cache_file
             doc['caches']['audition_verdicts'] = _audition_cache_file()
@@ -493,13 +515,22 @@ class DnServer(object):
             return 0, b'', b'', {}
         if op == 'health':
             # the replica-probe op (scatter-gather routers, load
-            # balancers): tiny, never queued behind admission
-            body = json.dumps({
+            # balancers): tiny, never queued behind admission.  The
+            # fault seam lets the chaos soak fail probes
+            # deterministically (a FaultInjected here propagates to
+            # _handle_conn, which drops the connection — exactly what
+            # a dead member looks like to a prober).
+            mod_faults.fire('member.health')
+            doc = {
                 'ok': not self.draining, 'draining': self.draining,
                 'pid': os.getpid(),
                 'uptime_s': round(time.monotonic() - self._t0, 3),
                 'inflight': self.admission.depth(),
-            }, sort_keys=True) + '\n'
+            }
+            if self.cluster is not None:
+                doc['member'] = self.member
+                doc['epoch'] = self.cluster.epoch
+            body = json.dumps(doc, sort_keys=True) + '\n'
             return 0, body.encode(), b'', {}
         if op == 'stats':
             body = json.dumps(self.stats_doc(), sort_keys=True,
@@ -514,7 +545,7 @@ class DnServer(object):
             return 0, body.encode(), b'', {}
         if op == 'build' and req.get('idempotency'):
             return self._execute_idempotent(req['idempotency'], req)
-        if op in ('scan', 'query', 'build') or \
+        if op in ('scan', 'query', 'build', 'query_partial') or \
                 (op == '_sleep' and
                  os.environ.get('DN_SERVE_TEST_OPS') == '1'):
             return self._execute_data(req)
@@ -628,6 +659,16 @@ class DnServer(object):
                                      % (mod_cli.ARG0, e.message))
                     rc = 1
                 except DNError as e:
+                    # cluster degraded responses ride the shared
+                    # DNError contract but mark the header: a
+                    # RouterPartitionError names the dead partitions
+                    # and is retryable (another router may have live
+                    # replicas); epoch mismatches are retryable too
+                    mp = getattr(e, 'missing_partitions', None)
+                    if mp is not None:
+                        flags['missing'] = list(mp)
+                    if getattr(e, 'retryable', False):
+                        flags['retryable_error'] = True
                     sys.stderr.write('%s: %s\n'
                                      % (mod_cli.ARG0, e.message))
                     rc = 1
@@ -716,10 +757,20 @@ class DnServer(object):
             'elapsed_ms': round((time.monotonic() - t0) * 1000, 3),
             'counters': scope_out,
         }
-        if flags['busy'] or flags['draining']:
-            # the request was never admitted: nothing ran, a retry is
-            # always safe — the client's backoff loop keys off this
+        if flags['busy'] or flags['draining'] or \
+                flags.get('retryable_error'):
+            # the request was never admitted (or failed degraded /
+            # pre-execution): nothing committed, a retry is always
+            # safe — the client's backoff loop keys off this
             extra['retryable'] = True
+        if flags.get('missing') is not None:
+            # the degraded-result contract: missing partitions are
+            # NAMED in the header, in both DN_ROUTER_PARTIAL modes
+            # (rc=0 partial merge under 'allow', rc=1 clean retryable
+            # error under 'error')
+            extra['missing_partitions'] = flags['missing']
+            if rc == 0:
+                extra['partial'] = True
         return rc, out, err, finish_obs(rc, extra)
 
     def _tree_lock(self, ds, dsname):
@@ -761,6 +812,17 @@ class DnServer(object):
         if op == 'build':
             return self._run_build(req, ds, config, dsname, opts,
                                    metrics_for_index, flags)
+        if op == 'query_partial':
+            return self._run_partial(req, ds, dsname, opts, backend,
+                                     flags)
+        if op == 'query' and self.router is not None and \
+                not opts.dry_run:
+            # cluster mode: this member routes — scatter the query to
+            # the partition owners and merge the partial aggregates
+            # (dry runs stay local: the plan shows this member's own
+            # tree view)
+            return self._run_routed_query(req, ds, dsname, opts,
+                                          backend, flags)
 
         query = mod_cli.dn_query_config(opts)
         key = mod_admission.compute_key(
@@ -797,6 +859,127 @@ class DnServer(object):
         mod_cli.dn_output(query, opts, result.clone_for_output(),
                           dsname)
         return 0
+
+    def _run_routed_query(self, req, ds, dsname, opts, backend,
+                          flags):
+        """Cluster-mode index query: scatter-gather through the
+        router, then the unmodified output layer over the merged
+        points — byte-identical to a single-process run when every
+        partition answered.  NO admission slot is held across the
+        scatter wait (the router blocks on REMOTE members; two
+        members routing at each other under full admission queues
+        would deadlock) — the local partial acquires its own slot
+        inside _local_partial."""
+        query = mod_cli.dn_query_config(opts)
+        key = mod_admission.compute_key(
+            req, _config_ident(backend.cbl_path))
+        interval = req.get('interval') or 'day'
+
+        def compute():
+            with obs_trace.span('serve.execute', op='query.routed'):
+                return self.router.scatter(ds, dsname, query,
+                                           interval, req)
+
+        # degraded errors (RouterPartitionError) propagate as DNError
+        # with their missing_partitions/retryable attrs intact — the
+        # job() handler frames the message and marks the header
+        (result, missing), shared = self.coalescer.run(key, compute,
+                                                       lease=flags)
+        flags['coalesced'] = shared
+        if missing:
+            flags['missing'] = list(missing)
+            sys.stderr.write(
+                'dn: warning: partial result: partition(s) %s '
+                'unavailable\n' % ','.join(str(p) for p in missing))
+        mod_cli.dn_output(query, opts, result.clone_for_output(),
+                          dsname)
+        return 0
+
+    def _run_partial(self, req, ds, dsname, opts, backend, flags):
+        """The member side of the scatter: execute the query over the
+        requested partitions of THIS member's shard walk and return
+        per-shard key items as JSON (the router merges them in global
+        find order)."""
+        if self.cluster is None:
+            mod_cli.fatal(DNError(
+                'not a cluster member (start with '
+                '--cluster/--member)'))
+        epoch = req.get('epoch')
+        if epoch != self.cluster.epoch:
+            # a router running a different topology file must never
+            # merge this member's partitions: clean retryable error
+            e = DNError('topology epoch mismatch (member has %d, '
+                        'router sent %s)'
+                        % (self.cluster.epoch, epoch))
+            e.retryable = True
+            raise e
+        pids = req.get('partitions')
+        known = set(self.cluster.partition_ids())
+        if not isinstance(pids, list) or not pids or \
+                not all(isinstance(p, int) and not isinstance(p, bool)
+                        and p in known for p in pids):
+            mod_cli.fatal(DNError(
+                'bad "partitions" in query_partial request'))
+        query = mod_cli.dn_query_config(opts)
+        key = mod_admission.compute_key(
+            req, _config_ident(backend.cbl_path))
+        interval = req.get('interval') or 'day'
+
+        def compute():
+            from . import router as mod_router
+            slot = flags['slot'] = self.admission.acquire()
+            try:
+                with self._tree_lock(ds, dsname).read(), \
+                        obs_trace.span('serve.execute',
+                                       op='query_partial'):
+                    return mod_router.partial_query(
+                        ds, query, interval, self.cluster, pids)
+            finally:
+                slot.release()
+
+        try:
+            shards, shared = self.coalescer.run(key, compute,
+                                                lease=flags)
+        except (mod_admission.BusyError,
+                mod_admission.DrainingError,
+                mod_admission.DeadlineError):
+            raise
+        except DNError as e:
+            mod_cli.fatal(e)
+        flags['coalesced'] = shared
+        body = json.dumps({'epoch': self.cluster.epoch,
+                           'member': self.member, 'shards': shards},
+                          sort_keys=True, separators=(',', ':'))
+        sys.stdout.write(body + '\n')
+        return 0
+
+    def _local_partial(self, partition_ids, partial_req):
+        """The router's in-process partial executor for partitions
+        this member itself owns: same admission-slot + tree-read-lock
+        discipline as a socket-delivered query_partial, without
+        dialing our own socket (a self-dial under a full admission
+        queue would deadlock the scatter)."""
+        from .. import datasource_for_name
+        from . import router as mod_router
+        backend = mod_config.ConfigBackendLocal(
+            partial_req.get('config') or None)
+        err, config = backend.load()
+        if err is not None and not getattr(err, 'is_enoent', False):
+            raise err
+        dsname = partial_req.get('ds')
+        ds = datasource_for_name(config, dsname)
+        if isinstance(ds, DNError):
+            raise ds
+        opts = _opts_shim(partial_req)
+        query = mod_cli.dn_query_config(opts)
+        interval = partial_req.get('interval') or 'day'
+        slot = self.admission.acquire()
+        try:
+            with self._tree_lock(ds, dsname).read():
+                return mod_router.partial_query(
+                    ds, query, interval, self.cluster, partition_ids)
+        finally:
+            slot.release()
 
     def _run_build(self, req, ds, config, dsname, opts,
                    metrics_for_index, flags):
@@ -867,12 +1050,20 @@ def sweep_configured_trees(warn=None):
     return acted
 
 
-def serve_main(socket_path=None, port=None, pidfile=None):
+def serve_main(socket_path=None, port=None, pidfile=None,
+               cluster=None, member=None, router_conf=None):
     """Run the daemon until SIGTERM/SIGINT, then drain.  Returns the
-    process exit code."""
+    process exit code.  `cluster` (an already-loaded, validated
+    topology.Topology) and `member` (this server's member name) start
+    the scatter-gather cluster mode (serve/topology.py,
+    serve/router.py).  The CLI loads and validates the topology file
+    and DN_ROUTER_* knobs exactly once and hands the results here —
+    re-reading them would open a window where the state just
+    validated/printed differs from the state actually served."""
     conf = mod_config.serve_config()
     if isinstance(conf, DNError):
         raise conf
+    topo = cluster
     pidfile = mod_lifecycle.pidfile_for(socket_path, pidfile)
 
     def warn(msg):
@@ -882,7 +1073,8 @@ def serve_main(socket_path=None, port=None, pidfile=None):
     mod_lifecycle.claim(socket_path=socket_path, port=port,
                         pidfile=pidfile, warn=warn)
     server = DnServer(socket_path=socket_path, port=port,
-                      pidfile=pidfile, conf=conf)
+                      pidfile=pidfile, conf=conf, cluster=topo,
+                      member=member, router_conf=router_conf)
     try:
         server.bind()
     except OSError as e:
@@ -897,8 +1089,10 @@ def serve_main(socket_path=None, port=None, pidfile=None):
     signal.signal(signal.SIGINT, on_signal)
     where = socket_path if socket_path is not None \
         else '%s:%d' % (server.host, server.bound_port)
-    sys.stderr.write('dn serve: listening on %s (pid %d)\n'
-                     % (where, os.getpid()))
+    aka = ' as member "%s" (epoch %d)' % (member, topo.epoch) \
+        if topo is not None else ''
+    sys.stderr.write('dn serve: listening on %s (pid %d)%s\n'
+                     % (where, os.getpid(), aka))
     server.serve_forever()
     sys.stderr.write('dn serve: drained; exiting\n')
     return 0

@@ -1,0 +1,256 @@
+"""The static cluster map for scatter-gather serving.
+
+A topology file describes a `dn serve` cluster: its members (name ->
+endpoint), its partitions (each a replica set of members), the shard
+assignment rule, and an epoch.  Every member loads the SAME file
+(`dn serve --cluster=TOPOLOGY.json --member=NAME`); any member can act
+as router for an incoming query, scattering partition-scoped partial
+queries to the owners and merging the partial aggregates
+(serve/router.py).
+
+File format (JSON):
+
+    {
+      "epoch": 1,
+      "assign": "hash",
+      "members": {
+        "a": {"endpoint": "/run/dn-a.sock"},
+        "b": {"endpoint": "10.0.0.2:9401"},
+        "c": {"endpoint": "10.0.0.3:9401"}
+      },
+      "partitions": [
+        {"id": 0, "replicas": ["a", "b"]},
+        {"id": 1, "replicas": ["b", "c"]},
+        {"id": 2, "replicas": ["c", "a"]}
+      ]
+    }
+
+* ``epoch`` — integer generation stamp.  Members reject partial
+  queries whose epoch differs from their loaded topology (a retryable
+  error), so a router and member running different topology files can
+  never silently merge mismatched partitions.
+* ``assign`` — the shard -> partition rule.  ``hash`` (default):
+  crc32 of the shard's file name modulo the partition count — stable
+  across processes and runs (never Python's salted hash()).
+  ``time-range``: partitions may carry ``after``/``before`` ISO-8601
+  bounds; a shard whose filename time-range starts inside a
+  partition's window belongs to it, and shards that match no window
+  (or carry no parseable time, e.g. an `all`-interval shard) fall
+  back to the hash rule.
+* ``partitions[].replicas`` — member names in PREFERENCE order: the
+  router tries the first live replica, failing over (and hedging) to
+  the rest.
+* ``members[].endpoint`` — a unix socket path or HOST:PORT, exactly
+  the `--remote` address forms (serve/client.parse_addr).
+
+Validation is strict and centralized here (load_topology raises the
+shared DNError contract; `dn serve --validate` reports it before any
+socket binds): duplicate/overlapping partition ids, replica sets
+naming unknown members, empty replica sets, members no partition
+uses, overlapping time ranges, and malformed endpoints are all
+rejected at load time, not at the first query that meets them.
+"""
+
+import json
+import os
+import zlib
+
+from ..errors import DNError
+from .. import jsvalues as jsv
+
+ASSIGN_MODES = ('hash', 'time-range')
+
+
+class Topology(object):
+    """The validated, immutable cluster map."""
+
+    def __init__(self, doc, path=None):
+        self.path = path
+        self.epoch = doc['epoch']
+        self.assign = doc.get('assign') or 'hash'
+        self.members = {name: dict(m)
+                        for name, m in doc['members'].items()}
+        parts = sorted(doc['partitions'], key=lambda p: p['id'])
+        self.partitions = [
+            {'id': p['id'], 'replicas': list(p['replicas']),
+             'after_ms': p.get('_after_ms'),
+             'before_ms': p.get('_before_ms')}
+            for p in parts]
+        self._by_id = {p['id']: p for p in self.partitions}
+
+    def partition_ids(self):
+        return [p['id'] for p in self.partitions]
+
+    def replicas(self, pid):
+        """Member names owning partition `pid`, preference order."""
+        return list(self._by_id[pid]['replicas'])
+
+    def endpoint(self, member):
+        return self.members[member]['endpoint']
+
+    def member_names(self):
+        return sorted(self.members)
+
+    def partitions_of(self, member):
+        return [p['id'] for p in self.partitions
+                if member in p['replicas']]
+
+    def _hash_partition(self, name):
+        idx = zlib.crc32(name.encode('utf-8')) % len(self.partitions)
+        return self.partitions[idx]['id']
+
+    def partition_of(self, shard_path, timeformat=None):
+        """The partition owning a shard file.  Deterministic from the
+        shard's basename (and, in time-range mode, its filename
+        time-range), so the router and every member agree without
+        coordination."""
+        name = os.path.basename(shard_path)
+        if self.assign == 'time-range' and timeformat:
+            from .. import index_query_mt as mod_iqmt
+            rng = mod_iqmt.shard_time_range(name, timeformat)
+            if rng is not None:
+                start_ms = rng[0]
+                for p in self.partitions:
+                    after = p['after_ms']
+                    before = p['before_ms']
+                    if after is None and before is None:
+                        continue      # windowless: hash-rule only
+                    if (after is None or start_ms >= after) and \
+                            (before is None or start_ms < before):
+                        return p['id']
+        return self._hash_partition(name)
+
+    def summary(self):
+        """The /stats and --validate view."""
+        return {
+            'path': self.path,
+            'epoch': self.epoch,
+            'assign': self.assign,
+            'members': {name: m['endpoint']
+                        for name, m in self.members.items()},
+            'partitions': [{'id': p['id'],
+                            'replicas': list(p['replicas'])}
+                           for p in self.partitions],
+        }
+
+
+def _parse_bound(p, key, pid):
+    """Validated ISO-8601 (or epoch-seconds) partition bound -> ms."""
+    raw = p.get(key)
+    if raw is None:
+        return None, None
+    if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+        return int(raw) * 1000, None
+    if isinstance(raw, str):
+        ms = jsv.date_parse(raw)
+        if ms is not None:
+            return ms, None
+    return None, ('partition %s: "%s" is not a valid date: %r'
+                  % (pid, key, raw))
+
+
+def validate_doc(doc):
+    """First violation of the topology document shape as a string, or
+    None; on success the partitions gain parsed _after_ms/_before_ms
+    fields (time-range mode)."""
+    if not isinstance(doc, dict):
+        return 'topology is not an object'
+    epoch = doc.get('epoch')
+    if not isinstance(epoch, int) or isinstance(epoch, bool) or \
+            epoch < 1:
+        return '"epoch" must be an integer >= 1'
+    assign = doc.get('assign', 'hash')
+    if assign not in ASSIGN_MODES:
+        return '"assign" must be one of: %s' % ', '.join(ASSIGN_MODES)
+    members = doc.get('members')
+    if not isinstance(members, dict) or not members:
+        return '"members" must be a non-empty object'
+    for name, m in members.items():
+        if not isinstance(m, dict) or \
+                not isinstance(m.get('endpoint'), str) or \
+                not m['endpoint']:
+            return 'member "%s": "endpoint" must be a non-empty ' \
+                'string' % name
+    parts = doc.get('partitions')
+    if not isinstance(parts, list) or not parts:
+        return '"partitions" must be a non-empty array'
+    seen_ids = set()
+    used = set()
+    ranges = []
+    for i, p in enumerate(parts):
+        if not isinstance(p, dict):
+            return 'partitions[%d] is not an object' % i
+        pid = p.get('id')
+        if not isinstance(pid, int) or isinstance(pid, bool) or \
+                pid < 0:
+            return 'partitions[%d]: "id" must be an integer >= 0' % i
+        if pid in seen_ids:
+            return 'partition id %d assigned twice (overlapping ' \
+                'partitions)' % pid
+        seen_ids.add(pid)
+        replicas = p.get('replicas')
+        if not isinstance(replicas, list) or not replicas:
+            return 'partition %d: "replicas" must be a non-empty ' \
+                'array' % pid
+        if len(set(replicas)) != len(replicas):
+            return 'partition %d: duplicate replica' % pid
+        for r in replicas:
+            if r not in members:
+                return 'partition %d: unknown member "%s"' % (pid, r)
+            used.add(r)
+        after_ms, err = _parse_bound(p, 'after', pid)
+        if err:
+            return err
+        before_ms, err = _parse_bound(p, 'before', pid)
+        if err:
+            return err
+        if after_ms is not None and before_ms is not None and \
+                before_ms <= after_ms:
+            return 'partition %d: "before" must be after "after"' \
+                % pid
+        p['_after_ms'] = after_ms
+        p['_before_ms'] = before_ms
+        if assign == 'time-range' and \
+                (after_ms is not None or before_ms is not None):
+            ranges.append((pid, after_ms, before_ms))
+    for name in members:
+        if name not in used:
+            return 'member "%s" owns no partition' % name
+    # time ranges must not overlap: two windows both claiming a shard
+    # would make partition_of order-dependent
+    for i, (pa, aa, ba) in enumerate(ranges):
+        for pb, ab, bb in ranges[i + 1:]:
+            lo = max(aa if aa is not None else float('-inf'),
+                     ab if ab is not None else float('-inf'))
+            hi = min(ba if ba is not None else float('inf'),
+                     bb if bb is not None else float('inf'))
+            if lo < hi:
+                return 'partitions %d and %d have overlapping time ' \
+                    'ranges' % (pa, pb)
+    return None
+
+
+def load_topology(path, member=None):
+    """Load + validate a topology file; raises DNError on any
+    violation (including `member` not naming a member when given)."""
+    try:
+        with open(path, 'r') as f:
+            raw = f.read()
+    except OSError as e:
+        raise DNError('cluster topology "%s"' % path,
+                      cause=DNError(str(e)))
+    try:
+        doc = json.loads(raw)
+    except ValueError as e:
+        raise DNError('cluster topology "%s": invalid JSON' % path,
+                      cause=DNError(str(e)))
+    err = validate_doc(doc)
+    if err is not None:
+        raise DNError('cluster topology "%s": %s' % (path, err))
+    topo = Topology(doc, path=path)
+    if member is not None and member not in topo.members:
+        raise DNError('cluster topology "%s": --member "%s" is not a '
+                      'member (have: %s)'
+                      % (path, member,
+                         ', '.join(topo.member_names())))
+    return topo

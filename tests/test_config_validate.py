@@ -218,3 +218,34 @@ def test_backend_load_returns_fresh_config_on_error(tmp_path):
     assert isinstance(err, DNError)
     assert 'failed to load config' in str(err)
     assert cfg.datasource_list() == []      # fresh initial config
+
+
+def test_router_config_defaults():
+    conf = mod_config.router_config(env={})
+    assert conf == {'probe_ms': 500, 'failures': 3,
+                    'cooldown_ms': 2000, 'hedge_ms': 0,
+                    'fetch_timeout_s': 60, 'partial': 'error'}
+
+
+def test_router_config_parses_overrides():
+    conf = mod_config.router_config(env={
+        'DN_ROUTER_PROBE_MS': '250', 'DN_ROUTER_FAILURES': '5',
+        'DN_ROUTER_COOLDOWN_MS': '500', 'DN_ROUTER_HEDGE_MS': '40',
+        'DN_ROUTER_FETCH_TIMEOUT_S': '10',
+        'DN_ROUTER_PARTIAL': 'allow'})
+    assert conf == {'probe_ms': 250, 'failures': 5,
+                    'cooldown_ms': 500, 'hedge_ms': 40,
+                    'fetch_timeout_s': 10, 'partial': 'allow'}
+
+
+def test_router_config_rejects_bad_values():
+    for env in ({'DN_ROUTER_PROBE_MS': 'x'},
+                {'DN_ROUTER_PROBE_MS': '10'},      # below minimum 50
+                {'DN_ROUTER_FAILURES': '0'},
+                {'DN_ROUTER_COOLDOWN_MS': '-1'},
+                {'DN_ROUTER_HEDGE_MS': '-1'},
+                {'DN_ROUTER_FETCH_TIMEOUT_S': '0'},
+                {'DN_ROUTER_PARTIAL': 'maybe'}):
+        err = mod_config.router_config(env=env)
+        assert isinstance(err, DNError), env
+        assert str(err).startswith(list(env)[0]), env

@@ -807,7 +807,10 @@ def test_serve_validate_ok(monkeypatch):
                    b'remote config ok: retries=2 backoff_ms=50 '
                    b'connect_timeout_s=5\n'
                    b'obs config ok: trace=off slow_ms=off '
-                   b'buckets=14\n')
+                   b'buckets=14\n'
+                   b'router config ok: probe_ms=500 failures=3 '
+                   b'cooldown_ms=2000 hedge_ms=0 fetch_timeout_s=60 '
+                   b'partial=error\n')
 
 
 def test_serve_validate_reports_armed_faults(monkeypatch):

@@ -18,6 +18,16 @@ asserting the repo's robustness contract end to end:
 Run the full soak (>= 500 injected faults across all sites, both
 DN_INDEX_FORMAT modes) via `make soak-faults`; `--fast` runs the
 miniature tier-1 variant.  Exits non-zero on any violation.
+
+`--cluster` runs the scatter-gather cluster drill instead (`make
+soak-cluster`): 3 members x 2-replica partitions (one member a
+SIGKILL-able subprocess), mixed routed-query traffic under armed
+router/member/transport faults, a mid-query SIGKILL of a partition
+owner, and a no-surviving-replica drill — asserting byte-identity vs
+the single-process run whenever any replica survives, the clean
+degraded-or-error contract (missing partitions NAMED, never a hang,
+traceback, or silently short bytes) when none does, and
+breaker/failover counters visible in /stats.
 """
 
 import argparse
@@ -379,6 +389,366 @@ class Soak(object):
         }
 
 
+class ClusterSoak(Soak):
+    """The scatter-gather drill: members a/c in-process, member b a
+    subprocess (so a partition owner can be SIGKILLed mid-query).
+    Topology: 3 partitions x 2 replicas — (a,b), (b,c), (c,a) — so
+    killing any ONE member leaves every partition a live replica."""
+
+    def __init__(self, ctx, verbose=True):
+        super(ClusterSoak, self).__init__(ctx, verbose=verbose)
+        self.socks = {}
+        self.servers = {}
+        self.proc_b = None
+        self.topo_path = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start_cluster(self):
+        root = self.ctx['root']
+        self.socks = {m: os.path.join(root, 'dn-%s.sock' % m)
+                      for m in 'abc'}
+        self.topo_path = os.path.join(root, 'topo.json')
+        with open(self.topo_path, 'w') as f:
+            json.dump({
+                'epoch': 1, 'assign': 'hash',
+                'members': {m: {'endpoint': self.socks[m]}
+                            for m in 'abc'},
+                'partitions': [
+                    {'id': 0, 'replicas': ['a', 'b']},
+                    {'id': 1, 'replicas': ['b', 'c']},
+                    {'id': 2, 'replicas': ['c', 'a']},
+                ],
+            }, f)
+        from dragnet_tpu.serve import topology as mod_topology
+        conf = {'max_inflight': 8, 'queue_depth': 32,
+                'deadline_ms': 0, 'coalesce': True, 'drain_s': 10}
+        for m in 'ac':
+            topo = mod_topology.load_topology(self.topo_path,
+                                              member=m)
+            self.servers[m] = mod_server.DnServer(
+                socket_path=self.socks[m], conf=dict(conf),
+                cluster=topo, member=m).start()
+        self.spawn_b()
+
+    def spawn_b(self):
+        if os.path.exists(self.socks['b']):
+            os.unlink(self.socks['b'])
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        env.pop('DN_FAULTS', None)   # armed per-round via rounds' env
+        self.proc_b = subprocess.Popen(
+            [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+             'serve', '--socket', self.socks['b'],
+             '--cluster', self.topo_path, '--member', 'b'],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            doc = mod_client.health(self.socks['b'], timeout_s=2.0)
+            if doc.get('ok'):
+                return
+            time.sleep(0.1)
+        raise RuntimeError('cluster member b never became healthy')
+
+    def stop_cluster(self):
+        for srv in self.servers.values():
+            try:
+                srv.stop()
+            except Exception:
+                pass
+        self.servers = {}
+        if self.proc_b is not None and self.proc_b.poll() is None:
+            self.proc_b.kill()
+            self.proc_b.wait()
+        self.proc_b = None
+
+    # -- checks -------------------------------------------------------
+
+    def check_routed(self, fmt, case, got, degraded_ok=True):
+        """The cluster contract: success must be byte-identical to
+        the single-process golden; failure must be a clean `dn: ...`
+        error (a degraded response names the missing partitions)."""
+        self.ops += 1
+        rc, out, err = got
+        gold = self.golden[(fmt, tuple(case))]
+        text = err.decode('utf-8', 'replace')
+        if 'Traceback' in text:
+            self.violate('%s %s: traceback in routed response: %r'
+                         % (fmt, ' '.join(case), text[-300:]))
+            return
+        if rc == 0:
+            if gold[0] != 0:
+                self.violate('%s %s: routed success where the '
+                             'single-process run fails'
+                             % (fmt, ' '.join(case)))
+            elif out != gold[1]:
+                self.violate('%s %s: routed success with divergent '
+                             'bytes' % (fmt, ' '.join(case)))
+            return
+        if 'dn:' not in text:
+            self.violate('%s %s: unclean routed failure: %r'
+                         % (fmt, ' '.join(case), text[-300:]))
+            return
+        if gold[0] != 0:
+            # the single-process run fails this case too (e.g. no
+            # metric can serve it): a clean routed failure IS the
+            # byte-contract match
+            self.clean_errors += 1
+            return
+        if not degraded_ok:
+            self.violate('%s %s: unexpected failure with every '
+                         'replica live: %r'
+                         % (fmt, ' '.join(case), text[-300:]))
+            return
+        self.clean_errors += 1
+
+    # -- rounds -------------------------------------------------------
+
+    def routed_rounds(self, spec, rounds, degraded_ok=True,
+                      env=None):
+        """Mixed routed-query traffic through every member as router
+        while `spec` is armed (in this process AND in member b, whose
+        registry re-arms from its inherited environment per op is not
+        possible — b runs armed only when spec was exported before
+        spawn; the in-process seams cover router/client/serve sides
+        deterministically)."""
+        prior = os.environ.get('DN_FAULTS')
+        if spec:
+            os.environ['DN_FAULTS'] = spec
+        base_env = {'DN_REMOTE_RETRIES': '3',
+                    'DN_REMOTE_BACKOFF_MS': '5',
+                    'DN_REMOTE_CONNECT_TIMEOUT_S': '5',
+                    'DN_SERVE_CLIENT_TIMEOUT_S': '60'}
+        base_env.update(env or {})
+        try:
+            for r in range(rounds):
+                for fmt in FORMATS:
+                    ds = self.ctx['ds'][fmt]
+                    for i, case in enumerate(query_cases(ds)):
+                        via = 'abc'[(r + i) % 3]
+                        got = run_cli(
+                            case[:1] + ['--remote', self.socks[via]] +
+                            case[1:], env=dict(base_env))
+                        self.check_routed(fmt, case, got,
+                                          degraded_ok=degraded_ok)
+        finally:
+            if prior is None:
+                os.environ.pop('DN_FAULTS', None)
+            else:
+                os.environ['DN_FAULTS'] = prior
+
+    def degraded_header_drill(self):
+        """router.dispatch at rate 1.0: every partition fails, and
+        the response header must NAME the missing partitions and be
+        retryable (DN_ROUTER_PARTIAL=error default)."""
+        prior = os.environ.get('DN_FAULTS')
+        os.environ['DN_FAULTS'] = 'router.dispatch:error:1.0'
+        try:
+            ds = self.ctx['ds'][FORMATS[0]]
+            rc, header, out, err = mod_client.request_bytes(
+                self.socks['a'],
+                {'op': 'query', 'ds': ds,
+                 'config': self.ctx['rc_path'],
+                 'queryconfig': {'breakdowns': [
+                     {'name': 'host', 'field': 'host'}]},
+                 'interval': 'day', 'opts': {}}, timeout_s=120.0)
+            self.ops += 1
+            if rc == 0:
+                self.violate('degraded drill: rc=0 with every '
+                             'partition dead')
+            elif not header.get('retryable'):
+                self.violate('degraded drill: response not marked '
+                             'retryable')
+            elif header.get('stats', {}).get('missing_partitions') \
+                    != [0, 1, 2]:
+                self.violate('degraded drill: missing partitions not '
+                             'named: %r' % header.get('stats'))
+            else:
+                self.clean_errors += 1
+        finally:
+            if prior is None:
+                os.environ.pop('DN_FAULTS', None)
+            else:
+                os.environ['DN_FAULTS'] = prior
+
+    def kill_owner_drill(self, nthreads=3, per_thread=4):
+        """SIGKILL member b while routed queries are in flight: every
+        in-flight and subsequent query must fail over to the
+        surviving replica of each partition (byte-identical) or fail
+        clean — never hang, never return short bytes."""
+        import threading
+        results = []
+        lock = threading.Lock()
+        # run_cli's per-call env install/restore mutates the PROCESS
+        # environment — concurrent workers must not each do it (the
+        # first finisher would strip the retry knobs out from under
+        # the others mid-failover).  Install once around the whole
+        # drill instead.
+        env = {'DN_REMOTE_RETRIES': '3', 'DN_REMOTE_BACKOFF_MS': '5',
+               'DN_REMOTE_CONNECT_TIMEOUT_S': '5',
+               'DN_SERVE_CLIENT_TIMEOUT_S': '60'}
+        prior = {}
+        for k, v in env.items():
+            prior[k] = os.environ.get(k)
+            os.environ[k] = v
+        started = threading.Barrier(nthreads + 1)
+
+        def worker(tid):
+            started.wait()
+            for i in range(per_thread):
+                fmt = FORMATS[(tid + i) % len(FORMATS)]
+                ds = self.ctx['ds'][fmt]
+                case = query_cases(ds)[(tid + i) %
+                                       len(query_cases(ds))]
+                got = run_cli(case[:1] +
+                              ['--remote', self.socks['a']] +
+                              case[1:])
+                with lock:
+                    results.append((fmt, case, got))
+
+        try:
+            threads = [threading.Thread(target=worker, args=(t,))
+                       for t in range(nthreads)]
+            for t in threads:
+                t.start()
+            started.wait()
+            time.sleep(0.05)     # let queries get in flight
+            self.proc_b.kill()   # SIGKILL the partition owner
+            self.proc_b.wait()
+            self.note('SIGKILLed member b mid-query')
+            for t in threads:
+                t.join(120)
+                if t.is_alive():
+                    self.violate('kill drill: query thread hung')
+            for fmt, case, got in results:
+                self.check_routed(fmt, case, got)
+            # after the kill: every partition still has a live
+            # replica (a or c), so routed queries must be
+            # BYTE-IDENTICAL again
+            for fmt in FORMATS:
+                ds = self.ctx['ds'][fmt]
+                for case in query_cases(ds):
+                    got = run_cli(case[:1] +
+                                  ['--remote', self.socks['a']] +
+                                  case[1:])
+                    self.check_routed(fmt, case, got,
+                                      degraded_ok=False)
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        doc = mod_client.stats(self.socks['a'], timeout_s=30.0)
+        cl = doc.get('cluster') or {}
+        counters = cl.get('counters') or {}
+        if counters.get('failovers', 0) < 1:
+            self.violate('kill drill: no failovers recorded in '
+                         '/stats after a dead partition owner')
+        if 'members' not in cl:
+            self.violate('kill drill: /stats cluster section missing '
+                         'member breaker states')
+        self.cluster_counters = counters
+
+    def no_replica_drill(self):
+        """Member b is dead; stop c too — partition 1 (replicas b,c)
+        has no survivor.  The response must be the clean degraded
+        error NAMING partition 1, and the header must be retryable."""
+        self.servers['c'].stop()
+        ds = self.ctx['ds'][FORMATS[0]]
+        rc, header, out, err = mod_client.request_bytes(
+            self.socks['a'],
+            {'op': 'query', 'ds': ds, 'config': self.ctx['rc_path'],
+             'queryconfig': {'breakdowns': [
+                 {'name': 'host', 'field': 'host'}]},
+             'interval': 'day', 'opts': {}}, timeout_s=120.0)
+        self.ops += 1
+        text = err.decode('utf-8', 'replace')
+        if rc == 0:
+            self.violate('no-replica drill: rc=0 with partition 1 '
+                         'dead')
+        elif 'Traceback' in text or 'dn:' not in text:
+            self.violate('no-replica drill: unclean failure: %r'
+                         % text[-300:])
+        elif header.get('stats', {}).get('missing_partitions') \
+                != [1]:
+            self.violate('no-replica drill: missing partition not '
+                         'named: %r' % header.get('stats'))
+        elif not header.get('retryable'):
+            self.violate('no-replica drill: degraded response not '
+                         'retryable')
+        else:
+            self.clean_errors += 1
+
+    def summary(self):
+        doc = super(ClusterSoak, self).summary()
+        doc['cluster'] = getattr(self, 'cluster_counters', {})
+        return doc
+
+
+# router/member/transport chaos for the cluster drill: dispatch and
+# merge faults surface the degraded contract, health faults churn the
+# breakers (probes + half-open recovery), transport faults drive
+# failover and the client retry loop
+CLUSTER_SPEC = ('router.dispatch:error:0.04:41,'
+                'router.merge:error:0.02:42,'
+                'member.health:error:0.15:43,'
+                'client.connect:error:0.06:44,'
+                'client.recv:error:0.05:45,'
+                'serve.accept:error:0.05:46,'
+                'serve.write:error:0.04:47')
+CLUSTER_DELAY_SPEC = ('router.dispatch:delay:0.3:48,'
+                      'iq.shard_read:delay:0.2:49')
+
+
+def soak_cluster(root, fast=False, verbose=True, floor=None):
+    """The cluster drill under `root`; returns the summary dict."""
+    mod_faults.reset()
+    ctx = make_corpus(root, n=400 if fast else 1200,
+                      days=5 if fast else 10)
+    for fmt in FORMATS:
+        build(ctx, fmt)
+    # router knobs for churn: fast probes, small breaker thresholds,
+    # hedging ON so delay faults exercise the hedge path (read at
+    # server construction)
+    os.environ.update({
+        'DN_ROUTER_PROBE_MS': '200', 'DN_ROUTER_FAILURES': '2',
+        'DN_ROUTER_COOLDOWN_MS': '500', 'DN_ROUTER_HEDGE_MS': '40',
+        'DN_ROUTER_FETCH_TIMEOUT_S': '30'})
+    s = ClusterSoak(ctx, verbose=verbose)
+    s.start_cluster()
+    try:
+        s.note('fault-free routed byte-identity round')
+        s.routed_rounds('', 1, degraded_ok=False)
+        rounds = 3 if fast else 12
+        s.note('armed routed rounds (%d) [%s]'
+               % (rounds, CLUSTER_SPEC))
+        s.routed_rounds(CLUSTER_SPEC, rounds)
+        s.note('delay + hedge rounds')
+        s.routed_rounds(CLUSTER_DELAY_SPEC, 1 if fast else 2)
+        s.note('degraded header drill')
+        s.degraded_header_drill()
+        if floor:
+            extra = 0
+            while extra < 60:
+                total = mod_vpipe.global_counters().get(
+                    'faults injected', 0)
+                if total >= floor:
+                    break
+                extra += 1
+                s.note('top-up round %d (%d/%d faults)'
+                       % (extra, total, floor))
+                s.routed_rounds(CLUSTER_SPEC, 1)
+        s.note('SIGKILL partition-owner drill')
+        s.kill_owner_drill(nthreads=2 if fast else 3,
+                           per_thread=2 if fast else 4)
+        s.note('no-surviving-replica drill')
+        s.no_replica_drill()
+    finally:
+        s.stop_cluster()
+    return s.summary()
+
+
 # the in-process mixed-fault spec: every site that can fire without
 # killing the soak process (kill/torn run under the subprocess drills)
 LOCAL_SPEC = ('sink.create:error:0.08:11,sink.flush:error:0.08:12,'
@@ -441,6 +811,9 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument('--fast', action='store_true',
                    help='miniature tier-1 variant')
+    p.add_argument('--cluster', action='store_true',
+                   help='run the scatter-gather cluster drill '
+                        'instead of the single-process soak')
     p.add_argument('--min-faults', type=int, default=None,
                    help='required injected-fault floor '
                         '(default: 500, or 50 with --fast)')
@@ -450,8 +823,9 @@ def main(argv=None):
 
     import tempfile
     t0 = time.time()
+    runner = soak_cluster if args.cluster else soak
     with tempfile.TemporaryDirectory(prefix='dn_soak_') as root:
-        summary = soak(root, fast=args.fast, floor=floor)
+        summary = runner(root, fast=args.fast, floor=floor)
     summary['elapsed_s'] = round(time.time() - t0, 1)
     print(json.dumps(summary, indent=2, sort_keys=True))
     if summary['violations']:
