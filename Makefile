@@ -5,8 +5,8 @@
 PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
-    bench-serve bench-cluster soak-faults soak-cluster clean \
-    parity-matrix
+    bench-serve bench-cluster bench-follow soak-faults soak-cluster \
+    soak-follow clean parity-matrix
 
 all: native
 
@@ -69,6 +69,18 @@ soak-cluster: native
 # and hedge fire rate (bench extras JSON)
 bench-cluster: native
 	$(PYTHON) bench.py --cluster-only
+
+# the continuous-ingest drill: an appender races a `dn follow` daemon
+# under armed follow.read/checkpoint/publish faults with mid-publish
+# SIGKILL drills — after every kill the resumed tree must byte-equal
+# a from-scratch build over the checkpointed prefix (docs/ingest.md)
+soak-follow: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --follow
+
+# the continuous-ingest legs only: steady-state follow rec/s and
+# append-to-queryable latency p50/p95 (bench extras JSON)
+bench-follow: native
+	$(PYTHON) bench.py --follow-only
 
 # golden byte-parity under every engine (the strongest single seal:
 # host per-record, vectorized, forced device, auto router), then the

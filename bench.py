@@ -1254,6 +1254,128 @@ def main_cluster():
     }))
 
 
+def follow_bench(tmpdir):
+    """The continuous-ingest legs (--follow-only / make bench-follow):
+
+    * steady-state catch-up throughput: a pre-grown log ingested by
+      the real FollowLoop in --once semantics (tail -> mini-batch ->
+      scan -> merge-publish -> checkpoint), rec/s and MB/s;
+    * append-to-queryable latency: a resident FollowLoop tails the
+      log while record bursts are appended, measuring append ->
+      batch published (shards renamed + caches invalidated — the
+      instant a query sees the data) p50/p95 over DN_BENCH_FOLLOW_REPS
+      bursts.  The batch-cut latency target (DN_FOLLOW_LATENCY_MS
+      semantics, 25 ms here) is part of the measured number ON
+      PURPOSE: it is the latency a reader actually experiences."""
+    import threading
+    from dragnet_tpu import query as mod_query
+    from dragnet_tpu.follow.loop import FollowLoop
+
+    n = int(os.environ.get('DN_BENCH_FOLLOW_RECORDS', '60000'))
+    reps = int(os.environ.get('DN_BENCH_FOLLOW_REPS', '12'))
+    burst = int(os.environ.get('DN_BENCH_FOLLOW_BURST', '400'))
+
+    datafile = os.path.join(tmpdir, 'follow.log')
+    idx = os.path.join(tmpdir, 'follow.idx')
+    start_ms = 1388534400000             # 2014-01-01
+    window_ms = 5 * 86400000
+    gen_to_file(n, datafile, mindate_ms=start_ms,
+                maxdate_ms=start_ms + window_ms)
+    nbytes = os.path.getsize(datafile)
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    ds = make_ds(datafile, idx)
+
+    # leg 1: catch-up over the pre-grown log (one process lifetime,
+    # bounded batches — the restart/recovery story in steady state)
+    conf = {'latency_ms': 0, 'max_bytes': 1 << 20, 'poll_ms': 5}
+    loop = FollowLoop(ds, metrics, 'day', [datafile], conf, once=True)
+    t0 = time.monotonic()
+    rc = loop.run()
+    catchup_s = time.monotonic() - t0
+    if rc != 0 or loop.records != n:
+        raise RuntimeError('follow catch-up failed (rc=%s, %d/%d '
+                           'records)' % (rc, loop.records, n))
+    catchup_batches = loop.batches
+
+    # leg 2: append-to-queryable against a resident loop; bursts land
+    # inside the same 5-day window, so every publish is a read-
+    # modify-publish rewrite of existing shards (the steady state)
+    mod = _mktestdata()
+    conf = {'latency_ms': 25, 'max_bytes': 1 << 20, 'poll_ms': 5}
+    live = FollowLoop(ds, metrics, 'day', [datafile], conf)
+    thr = threading.Thread(target=live.run, daemon=True)
+    thr.start()
+    lat = []
+    bi = n
+    for rep in range(reps):
+        target = live.records + burst
+        with open(datafile, 'a') as f:
+            for _ in range(burst):
+                f.write(json.dumps(
+                    mod.make_record(bi % n, n, start_ms,
+                                    start_ms + window_ms),
+                    separators=(',', ':')) + '\n')
+                bi += 1
+        t0 = time.monotonic()
+        deadline = t0 + 120
+        while live.records < target and thr.is_alive() and \
+                time.monotonic() < deadline:
+            time.sleep(0.001)
+        if live.records < target:
+            raise RuntimeError('append burst %d never became '
+                               'queryable' % rep)
+        lat.append((time.monotonic() - t0) * 1000)
+    live.request_stop()
+    thr.join(timeout=60)
+
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p95 = lat[min(len(lat) - 1, int(len(lat) * 0.95))]
+    return {
+        'follow_records': n,
+        'follow_mb': round(nbytes / 1e6, 1),
+        'follow_catchup_rec_per_sec': round(n / catchup_s),
+        'follow_catchup_mb_per_sec': round(nbytes / 1e6 / catchup_s,
+                                           1),
+        'follow_catchup_batches': catchup_batches,
+        'follow_burst_records': burst,
+        'follow_bursts': reps,
+        'follow_append_to_queryable_p50_ms': round(p50, 1),
+        'follow_append_to_queryable_p95_ms': round(p95, 1),
+        'follow_live_batches': live.batches,
+    }
+
+
+def main_follow():
+    """Continuous-ingest legs only (`make bench-follow` /
+    --follow-only)."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_follow_')
+    try:
+        fb = follow_bench(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    sys.stderr.write(
+        'bench-follow: catch-up %s rec/s (%s MB/s, %d batches over '
+        '%d records); append-to-queryable p50 %.1fms p95 %.1fms '
+        '(%d bursts x %d records, %d live batches)\n'
+        % (fb['follow_catchup_rec_per_sec'],
+           fb['follow_catchup_mb_per_sec'],
+           fb['follow_catchup_batches'], fb['follow_records'],
+           fb['follow_append_to_queryable_p50_ms'],
+           fb['follow_append_to_queryable_p95_ms'],
+           fb['follow_bursts'], fb['follow_burst_records'],
+           fb['follow_live_batches']))
+    print(json.dumps({
+        'metric': 'follow_catchup_rec_per_sec',
+        'value': fb['follow_catchup_rec_per_sec'],
+        'unit': 'rec/s',
+        'vs_baseline': None,
+        'extra': fb,
+    }))
+
+
 def main_parse():
     """Parse-lane legs only (`make bench-parse` / --parse-only):
     host-record vs native vs vector vs device parse MB/s plus
@@ -1382,6 +1504,9 @@ def main():
     if '--cluster-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'cluster':
         return main_cluster()
+    if '--follow-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'follow':
+        return main_follow()
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)

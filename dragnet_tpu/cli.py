@@ -103,6 +103,10 @@ DN_OPTIONS = [
     (['iq-stack'], 'string', None),
     (['index-path'], 'string', None),
     (['member'], 'string', None),
+    # `dn follow` catch-up mode: ingest to the sources' current EOF,
+    # publish, checkpoint, and exit instead of tailing forever.  Not
+    # in USAGE_TEXT (byte-pinned); documented in docs/ingest.md.
+    (['once'], 'bool', None),
     # ingest parse-lane override (not in USAGE_TEXT: the usage output
     # is byte-pinned to the reference goldens; documented in
     # docs/performance.md).  Equivalent to DN_PARSE for one run:
@@ -900,10 +904,113 @@ def cmd_stats(ctx, argv):
         sys.stdout.write(obs_export.prometheus_text(counters=counters))
         return 0
     import json as mod_json
+    doc = obs_export.stats_section(counters=counters)
+    from .follow import stats_doc as follow_stats
+    fs = follow_stats()
+    if fs is not None:
+        # continuous-ingest telemetry: source offsets, batches
+        # published, checkpoint age, ingest lag (docs/ingest.md)
+        doc['follow'] = fs
     sys.stdout.write(mod_json.dumps(
-        obs_export.stats_section(counters=counters),
-        sort_keys=True, indent=2) + '\n')
+        doc, sort_keys=True, indent=2) + '\n')
     return 0
+
+
+def cmd_follow(ctx, argv):
+    """`dn follow [--interval=I] [--index-config=F] [--once]
+    [--validate] DATASOURCE [FILE ...]`: the continuous-ingest daemon
+    (follow/loop.py) — tail growing files (FILE of `-` reads stdin;
+    default: the datasource's own data path when it is a regular
+    file), cut mini-batches by DN_FOLLOW_LATENCY_MS /
+    DN_FOLLOW_MAX_BYTES, and incrementally publish shard updates with
+    an exactly-once checkpoint.  Not in USAGE_TEXT — the usage output
+    is byte-pinned to the reference goldens; documented in
+    docs/ingest.md."""
+    import os
+    opts = dn_parse_args(argv, ['interval', 'index-config', 'once',
+                                'validate'])
+    if len(opts._args) < 1:
+        raise UsageError('missing arguments')
+    dsname = opts._args[0]
+    sources = opts._args[1:]
+    indexcfg = _read_index_config(opts.index_config) \
+        if opts.index_config else None
+    if opts.interval not in ('hour', 'day', 'all'):
+        fatal(DNError('interval not supported: "%s"' % opts.interval))
+
+    # the follow knobs share the fail-fast validation contract with
+    # the serve/remote/router/fault knobs: a malformed value is caught
+    # here (and by --validate), not at the first batch that needs it
+    conf = mod_config.follow_config()
+    if isinstance(conf, DNError):
+        fatal(conf)
+    faults_conf = mod_config.faults_config()
+    if isinstance(faults_conf, DNError):
+        fatal(faults_conf)
+    obs_conf = mod_config.obs_config()
+    if isinstance(obs_conf, DNError):
+        fatal(obs_conf)
+
+    ds = datasource_for_name(ctx['config'], dsname)
+    if isinstance(ds, DNError):
+        fatal(ds)
+    if getattr(ds, 'ds_indexpath', None) is None:
+        fatal(DNError('datasource is missing "indexpath"'))
+    if opts.interval != 'all' and \
+            getattr(ds, 'ds_timefield', None) is None:
+        fatal(DNError('datasource is missing "timefield"'))
+    metrics = metrics_for_index(ctx['config'], dsname,
+                                index_config=indexcfg)
+    if len(metrics) == 0:
+        fatal(DNError('no metrics defined for dataset "%s"' % dsname))
+
+    if not sources:
+        datapath = getattr(ds, 'ds_datapath', None)
+        if datapath is None or not os.path.isfile(datapath):
+            fatal(DNError('no sources given and the datasource path '
+                          'is not a regular file; name the file(s) '
+                          'to follow (or "-" for stdin)'))
+        sources = [datapath]
+    norm = []
+    for src in sources:
+        norm.append(src if src == '-' else os.path.abspath(src))
+    if norm.count('-') > 1:
+        raise UsageError('stdin ("-") may be named at most once')
+
+    if getattr(opts, 'validate', None):
+        # dry mode (matching `dn serve --validate`): the DN_FOLLOW_* /
+        # DN_FAULTS / obs knobs and the source arguments were just
+        # validated through the paths the daemon uses; report the
+        # resolved configuration and exit without touching anything
+        sys.stdout.write(
+            'follow config ok: latency_ms=%d max_bytes=%d '
+            'poll_ms=%d\n'
+            % (conf['latency_ms'], conf['max_bytes'],
+               conf['poll_ms']))
+        sys.stdout.write(
+            'obs config ok: trace=%s slow_ms=%s buckets=%d\n'
+            % (obs_conf['trace'] or 'off',
+               obs_conf['slow_ms'] if obs_conf['slow_ms'] is not None
+               else 'off', len(obs_conf['buckets'])))
+        sys.stdout.write(
+            'follow plan: datasource=%s interval=%s index=%s '
+            'sources=%s\n'
+            % (dsname, opts.interval, ds.ds_indexpath,
+               ','.join(norm)))
+        sites = faults_conf['sites']
+        if sites:
+            sys.stdout.write(
+                'faults armed: %s\n' % ' '.join(
+                    '%s:%s:%g:%d' % (s, k, r, seed)
+                    for s, (k, r, seed) in sorted(sites.items())))
+        return 0
+
+    from .follow import loop as mod_floop
+    try:
+        return mod_floop.follow_main(ds, metrics, opts.interval, norm,
+                                     conf, once=bool(opts.once))
+    except DNError as e:
+        fatal(e)
 
 
 def cmd_serve(ctx, argv):
@@ -1030,6 +1137,7 @@ COMMANDS = {
     'metric-list': cmd_metric_list,
     'metric-remove': cmd_metric_remove,
     'build': cmd_build,
+    'follow': cmd_follow,
     'index-config': cmd_index_config,
     'index-read': cmd_index_read,
     'index-scan': cmd_index_scan,

@@ -461,6 +461,50 @@ def router_config(env=None):
     return rv
 
 
+# --- continuous-ingest knobs (DN_FOLLOW_*) ----------------------------
+#
+# Same contract as the serve/remote knobs: parsed and validated in one
+# place (follow/loop.py consumes them; `dn follow --validate` checks
+# them up front).  Each entry: (env name, kind, default, min).
+
+_FOLLOW_KNOBS = [
+    # target mini-batch latency: a pending batch is cut once its
+    # oldest bytes are this old (StreamBox-HBM's target-latency
+    # batching); 0 cuts as soon as any complete line is pending
+    ('DN_FOLLOW_LATENCY_MS', 'int', 500, 0),
+    # byte budget: a pending batch is cut early once it holds this
+    # many bytes, whatever its age
+    ('DN_FOLLOW_MAX_BYTES', 'int', 4 << 20, 1),
+    # idle poll cadence when no source produced new bytes
+    ('DN_FOLLOW_POLL_MS', 'int', 50, 1),
+]
+
+
+def follow_config(env=None):
+    """The resolved DN_FOLLOW_* knob dict (keys: latency_ms,
+    max_bytes, poll_ms), or DNError on the first malformed value —
+    the shared fail-fast contract `dn follow --validate` checks."""
+    if env is None:
+        env = os.environ
+    rv = {}
+    for name, kind, default, minimum in _FOLLOW_KNOBS:
+        key = name[len('DN_FOLLOW_'):].lower()
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        if value < minimum:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        rv[key] = value
+    return rv
+
+
 # --- observability knobs (DN_TRACE / DN_SLOW_MS / DN_METRICS_BUCKETS) -
 #
 # Same contract as the serve/remote knobs: parsed and validated in one

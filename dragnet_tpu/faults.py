@@ -64,6 +64,9 @@ SITES = (
     'router.dispatch',  # scatter-gather: per-partition dispatch
     'router.merge',     # scatter-gather: partial-aggregate merge
     'member.health',    # dn serve: the health op a router probes
+    'follow.read',      # dn follow: tailer source reads
+    'follow.checkpoint',  # dn follow: checkpoint tmp write
+    'follow.publish',   # dn follow: batch publish (pre-commit)
 )
 
 

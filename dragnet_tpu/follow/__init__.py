@@ -1,0 +1,37 @@
+"""`dn follow` — continuous ingest: tail live streams into
+incrementally-published indexes.
+
+The batch pipeline this repo grew (byteparse -> columnar scan ->
+journaled index publish) assumed a frozen corpus; the prototypical
+workload — production HTTP request logs — is a live stream.  This
+package closes the gap with a long-lived ingest daemon:
+
+* ``tailer``     — tail growing files (and stdin): bounded reads,
+  rotation/truncation detection via stat identity, and the
+  held-partial-line discipline (ingest.LineAssembler) so a chunk
+  ending mid-line is never parsed as a truncated record.
+* ``batcher``    — assemble complete-line buffers into mini-batches
+  cut by target latency (DN_FOLLOW_LATENCY_MS) and/or byte budget
+  (DN_FOLLOW_MAX_BYTES), StreamBox-HBM's target-latency batching.
+* ``publisher``  — run each mini-batch through the existing
+  byteparse -> columnar -> index path (a spool DatasourceFile +
+  index_scan), merge the new points into the affected shards
+  (read-modify-publish through the metric_rows seam), and publish the
+  whole touched-shard set two-phase through the PR 6 commit journal.
+* ``checkpoint`` — the durable source-offset record
+  (`<indexroot>/.dn_follow/checkpoint.json`).  Its update rides the
+  SAME commit journal as the shards (publish_prepared extra_paths),
+  which is what makes ingest exactly-once across kill -9: a reader
+  only ever sees a pre-batch or post-batch (shards AND checkpoint)
+  tree, so the resume offset can never disagree with the published
+  data.
+* ``loop``       — the daemon: poll -> batch -> publish, drain-safe
+  stop, --once catch-up mode, follow.* fault seams, and the
+  follow telemetry (/stats `follow` section + follow_* metrics in
+  the PR 7 registry).
+
+See docs/ingest.md for the model, the checkpoint format, rotation
+semantics, and the exactly-once guarantee's boundaries.
+"""
+
+from .loop import stats_doc  # noqa: F401  (the /stats `follow` seam)

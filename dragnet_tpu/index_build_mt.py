@@ -279,10 +279,19 @@ def _prepare_task(metrics, indexpath, config, parts, catalog, suffix,
     return task
 
 
-def publish_prepared(journal, sinks, paths):
-    """The commit phase shared by the block and streaming publishers:
-    land the journal's commit record (THE commit point), rename every
-    prepared tmp into place in bucket order, retire the journal.
+def publish_prepared(journal, sinks, paths, extra_paths=None):
+    """The commit phase shared by the block, streaming, and follow
+    publishers: land the journal's commit record (THE commit point),
+    rename every prepared tmp into place in bucket order, retire the
+    journal.
+
+    `extra_paths` is the append-merge publish seam `dn follow` rides:
+    non-shard files (its durable checkpoint) whose complete tmps were
+    pre-written at journal.tmp_for(final).  They join the SAME commit
+    record and rename after the shards, so a batch's shard updates and
+    its checkpoint land atomically-or-not-at-all across kill -9 — the
+    checkpoint can never claim bytes whose shards rolled back, nor
+    miss bytes whose shards rolled forward.
 
     Rename failures do NOT discard state: the commit record makes the
     tmps durable publish intent, so every remaining tmp and the
@@ -293,15 +302,22 @@ def publish_prepared(journal, sinks, paths):
     error still re-raises so the caller reports the failure."""
     from .index_query_mt import shard_cache_invalidate
     from .obs import metrics as obs_metrics
+    extra_paths = list(extra_paths or [])
     with obs_metrics.timed_stage('index_build.commit',
                                  nshards=len(paths)):
-        journal.record_commit(paths)
+        journal.record_commit(list(paths) + extra_paths)
         err = None
         for sink, path in zip(sinks, paths):
             try:
                 sink.commit(discard_on_error=False)
                 shard_cache_invalidate(path)
             except BaseException as e:
+                if err is None:
+                    err = e
+        for path in extra_paths:
+            try:
+                os.rename(journal.tmp_for(path), path)
+            except OSError as e:
                 if err is None:
                     err = e
         if err is not None:

@@ -894,6 +894,36 @@ class DncIndexQuerier(IndexQuerierBase):
             aggr.write_key(tuple(keys), int(s) if flags[g] else s)
         return True
 
+    def metric_rows(self, mi, names):
+        """The append-merge read seam (`dn follow`), DNC engine: metric
+        `mi`'s raw stored rows in append order — i64 columns decode to
+        Python ints, dictionary columns to their stored strings (NULL
+        codes to None), the value column to int when its isint flag is
+        set — exactly the values the writer buffered, so re-writing
+        them reproduces the same typed columns."""
+        if not (0 <= mi < len(self._tables)):
+            raise DNError('executing query: no such table '
+                          '"dragnet_index_%s"' % mi)
+        t = self._tables[mi]
+        n = t['nrows']
+        out_cols = []
+        for name in names:
+            c = self._column(t, sqlite3_escape(name))
+            if c['kind'] == 'i64':
+                out_cols.append(
+                    self._view(c['off'], n, np.int64).tolist())
+            else:
+                strings = self._dict_strings(c, self._dict_entries(c))
+                out_cols.append(
+                    [None if k < 0 else strings[k]
+                     for k in self._codes(c, t).tolist()])
+        values = self._view(t['value_off'], n, np.float64).tolist()
+        isint = self._view(t['isint_off'], n, np.uint8).tolist()
+        vals = [int(v) if f else v for v, f in zip(values, isint)]
+        if not out_cols:
+            return [(v,) for v in vals]
+        return list(zip(*(out_cols + [vals])))
+
     def _dict_strings(self, c, entries):
         cached = c.get('_strings')
         if cached is None:

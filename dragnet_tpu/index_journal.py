@@ -55,13 +55,21 @@ from .vpipe import counter_bump
 
 JOURNAL_PREFIX = '.dn_build.'
 QUARANTINE_DIR = '.dn_quarantine'
+# `dn follow`'s durable state (checkpoint.json, the mini-batch spool)
+# lives under this subdirectory of the index root; its checkpoint
+# publishes through the SAME commit journal as the shards, so the
+# sweep treats its tmps like shard tmps
+FOLLOW_DIR = '.dn_follow'
 
 # tmp names: `<shard>.<pid>` (legacy single-sink flushes) or
 # `<shard>.<pid>.<seq>` (journaled builds); shards are `all` or
-# `*.sqlite`.  A SIGKILLed SQLite engine additionally leaves its own
+# `*.sqlite`, plus the follow checkpoint (`checkpoint.json.<pid>.<seq>`
+# under FOLLOW_DIR — it rides the same two-phase publish).  A
+# SIGKILLed SQLite engine additionally leaves its own
 # `-journal`/`-wal`/`-shm` sidecars next to the tmp — same litter.
 _TMP_RE = re.compile(
-    r'^(all|.*\.sqlite)(\.\d+)+(-(journal|wal|shm))?$')
+    r'^(all|.*\.sqlite|checkpoint\.json)(\.\d+)+'
+    r'(-(journal|wal|shm))?$')
 
 _SEQ_LOCK = threading.Lock()
 _SEQ = [0]
@@ -83,6 +91,7 @@ def is_index_litter(name):
     base = os.path.basename(name)
     return (base.startswith(JOURNAL_PREFIX) or
             base == QUARANTINE_DIR or
+            base == FOLLOW_DIR or
             _TMP_RE.match(base) is not None)
 
 
@@ -250,7 +259,7 @@ def sweep_index_tree(indexroot):
         _roll_forward(indexroot, jpath, doc, result)
 
     rolled_back = False
-    for sub in ('', 'by_day', 'by_hour'):
+    for sub in ('', 'by_day', 'by_hour', FOLLOW_DIR):
         d = os.path.join(indexroot, sub) if sub else indexroot
         try:
             entries = sorted(os.listdir(d))
@@ -315,6 +324,47 @@ def cleanup_own_stale(indexroot):
             os.unlink(jpath)
         except OSError:
             pass
+
+
+def recover_own_committed(indexroot):
+    """Roll THIS process's committed-but-unrenamed journals forward
+    (finish the renames, retire the record) and return the final
+    paths completed.  The follow publisher's retry seam: an
+    in-process failure AFTER the commit record (a rename blowing up
+    mid-set) leaves complete, fsynced intent — every tmp was fully
+    prepared before the record landed.  `cleanup_own_stale` would
+    quarantine that intent as superseded, which is correct for a
+    full rebuild (the new build rewrites everything) but WRONG for
+    an incremental merge: the retry would then re-merge its batch
+    over a half-renamed tree and double-count every point in the
+    shards that did rename.  Completing the intent first lets the
+    retry observe the batch as already published (the checkpoint
+    seq renamed with it) and skip it exactly."""
+    indexroot = os.path.abspath(indexroot)
+    try:
+        names = sorted(os.listdir(indexroot))
+    except OSError:
+        return []
+    me = str(os.getpid())
+    finals = []
+    result = {'rollforwards': 0}
+    for name in names:
+        if not (name.startswith(JOURNAL_PREFIX) and
+                name.endswith('.json')):
+            continue
+        parts = name.split('.')
+        if len(parts) < 3 or parts[2] != me:
+            continue
+        jpath = os.path.join(indexroot, name)
+        try:
+            with open(jpath) as f:
+                doc = json.loads(f.read())
+        except (OSError, ValueError):
+            continue                 # cleanup_own_stale quarantines
+        _roll_forward(indexroot, jpath, doc, result)
+        finals.extend(final for _, final in (doc.get('entries')
+                                             or []))
+    return finals
 
 
 # -- TTL-throttled sweep for the query path --------------------------------

@@ -268,6 +268,22 @@ class IndexQuerier(IndexQuerierBase):
         for row in cur.fetchall():
             yield dict(zip(names, row))
 
+    def metric_rows(self, mi, names):
+        """The append-merge read seam (`dn follow`): metric `mi`'s raw
+        stored rows — one (key..., value) tuple per row, breakdown
+        columns in `names` order — in INSERT order (rowid order, the
+        same order stack_blocks already relies on).  A follow batch
+        seeds its per-shard merge aggregator from these rows, so the
+        rewritten shard preserves the original emission order
+        byte-exactly."""
+        cols = [sqlite3_escape(n) for n in names] + ['value']
+        sql = 'SELECT %s from dragnet_index_%d' % (','.join(cols), mi)
+        try:
+            return self.qi_db.execute(sql).fetchall()
+        except sqlite3.Error as e:
+            raise DNError('executing query "%s"' % sql,
+                          cause=DNError(str(e)))
+
     def stack_blocks(self, table, filt, groupby):
         """Columnar block export for the stacked cross-shard path
         (index_query_stack): the raw matching rows — no GROUP BY, no

@@ -52,36 +52,87 @@ def open_byte_source(path, chunk_size=1 << 20):
             yield chunk
 
 
-def iter_chunk_lines(chunks):
-    """THE chunk-boundary joiner: yield complete lines from an
-    iterable of byte chunks.  One implementation serves the file path
-    (iter_lines), the stream path (iter_stream_lines), and — via
-    iter_line_buffers — the raw-byte parse lanes, so the
-    join-across-chunks semantics can't drift apart.
+class LineAssembler(object):
+    """THE chunk-boundary joiner, incremental form: feed() byte chunks
+    in, get back buffers of COMPLETE lines (trailing newline
+    included); a chunk ending mid-line is *held* — never emitted as a
+    truncated record — until more bytes arrive or the caller flushes
+    (EOF / stop).  One implementation serves the batch paths
+    (iter_chunk_lines, iter_line_buffers, and through them iter_lines
+    / iter_stream_lines / the raw-byte parse lanes) AND the live-tail
+    path (`dn follow`'s source tailer), so the join-across-chunks
+    semantics can't drift apart.
+
+    The live-tail case is why the carry must be explicit: a growing
+    file routinely ends mid-line (the appender's write() landed
+    between our read()s), and a joiner that emitted the partial tail
+    at iterator end would hand the parser a truncated record that the
+    eventual complete line then duplicates.
 
     The carry between chunks is a *list* of chunk references, joined
     only when a newline finally arrives — appending chunks to a bytes
     buffer would re-copy the whole accumulated tail every read and go
     quadratic on multi-MB single-line inputs."""
-    tail = []
-    for chunk in chunks:
+
+    __slots__ = ('_tail', '_npending')
+
+    def __init__(self):
+        self._tail = []
+        self._npending = 0
+
+    def feed(self, chunk):
+        """Absorb one chunk; returns a buffer of complete lines
+        (possibly spanning the held carry), or b'' when the chunk left
+        no line complete."""
         nl = chunk.rfind(b'\n')
         if nl == -1:
             if chunk:
-                tail.append(chunk)
-            continue
-        head = chunk[:nl]
-        if tail:
-            tail.append(head)
-            head = b''.join(tail)
-            tail = []
-        for line in head.split(b'\n'):
-            yield line
+                self._tail.append(chunk)
+                self._npending += len(chunk)
+            return b''
+        head = chunk[:nl + 1]
+        if self._tail:
+            self._tail.append(head)
+            head = b''.join(self._tail)
+            self._tail = []
+            self._npending = 0
         rest = chunk[nl + 1:]
         if rest:
-            tail.append(rest)
-    if tail:
-        yield b''.join(tail)
+            self._tail.append(rest)
+            self._npending = len(rest)
+        return head
+
+    def pending(self):
+        """Bytes currently held mid-line (the tailer's checkpoint
+        offset is its read position minus this)."""
+        return self._npending
+
+    def flush(self):
+        """Give up the held partial line (no trailing newline), or
+        b''.  EOF-at-stop semantics: a file whose last line is
+        unterminated still yields that line when the stream ends, just
+        as the batch paths (and the reference's catstreams) do."""
+        if not self._tail:
+            return b''
+        out = b''.join(self._tail)
+        self._tail = []
+        self._npending = 0
+        return out
+
+
+def iter_chunk_lines(chunks):
+    """Yield complete lines (no newline) from an iterable of byte
+    chunks, joining lines split across chunk boundaries
+    (LineAssembler); a final partial line flushes last."""
+    asm = LineAssembler()
+    for chunk in chunks:
+        buf = asm.feed(chunk)
+        if buf:
+            for line in buf[:-1].split(b'\n'):
+                yield line
+    last = asm.flush()
+    if last:
+        yield last
 
 
 def iter_line_buffers(chunks):
@@ -90,24 +141,14 @@ def iter_line_buffers(chunks):
     line flushes last, without one).  This is the ingest unit of the
     columnar byte-parse lanes — one buffer per read chunk, complete
     lines only, identical carry discipline to iter_chunk_lines."""
-    tail = []
+    asm = LineAssembler()
     for chunk in chunks:
-        nl = chunk.rfind(b'\n')
-        if nl == -1:
-            if chunk:
-                tail.append(chunk)
-            continue
-        head = chunk[:nl + 1]
-        if tail:
-            tail.append(head)
-            head = b''.join(tail)
-            tail = []
-        yield head
-        rest = chunk[nl + 1:]
-        if rest:
-            tail.append(rest)
-    if tail:
-        yield b''.join(tail)
+        buf = asm.feed(chunk)
+        if buf:
+            yield buf
+    last = asm.flush()
+    if last:
+        yield last
 
 
 def _file_chunks(paths, chunk_size):

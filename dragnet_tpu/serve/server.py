@@ -439,6 +439,13 @@ class DnServer(object):
             # scatter-gather observability: per-member breaker
             # states, failover/hedge/degraded counters (router.py)
             doc['cluster'] = self.router.stats_doc()
+        from ..follow import stats_doc as follow_stats
+        fs = follow_stats()
+        if fs is not None:
+            # continuous-ingest telemetry when a follow loop runs in
+            # this process: source offsets, batches published,
+            # checkpoint age, ingest lag (docs/ingest.md)
+            doc['follow'] = fs
         try:
             from ..device_scan import _audition_cache_file
             doc['caches']['audition_verdicts'] = _audition_cache_file()

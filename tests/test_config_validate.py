@@ -249,3 +249,27 @@ def test_router_config_rejects_bad_values():
         err = mod_config.router_config(env=env)
         assert isinstance(err, DNError), env
         assert str(err).startswith(list(env)[0]), env
+
+
+def test_follow_config_defaults():
+    conf = mod_config.follow_config(env={})
+    assert conf == {'latency_ms': 500, 'max_bytes': 4 << 20,
+                    'poll_ms': 50}
+
+
+def test_follow_config_parses_overrides():
+    conf = mod_config.follow_config(env={
+        'DN_FOLLOW_LATENCY_MS': '0', 'DN_FOLLOW_MAX_BYTES': '1024',
+        'DN_FOLLOW_POLL_MS': '5'})
+    assert conf == {'latency_ms': 0, 'max_bytes': 1024, 'poll_ms': 5}
+
+
+def test_follow_config_rejects_bad_values():
+    for env in ({'DN_FOLLOW_LATENCY_MS': 'x'},
+                {'DN_FOLLOW_LATENCY_MS': '-1'},
+                {'DN_FOLLOW_MAX_BYTES': '0'},
+                {'DN_FOLLOW_MAX_BYTES': '12.5'},
+                {'DN_FOLLOW_POLL_MS': '0'}):
+        err = mod_config.follow_config(env=env)
+        assert isinstance(err, DNError), env
+        assert str(err).startswith(list(env)[0]), env

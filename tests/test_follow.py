@@ -1,0 +1,638 @@
+"""`dn follow` (dragnet_tpu/follow/): continuous ingest into
+incrementally-published indexes.
+
+The headline contracts under test:
+
+* BYTE-EQUALITY — after any sequence of follow batches (and appends
+  between them), the index tree is byte-identical to a from-scratch
+  `dn build` over the same input prefix, in both DN_INDEX_FORMAT
+  modes (the per-shard read-modify-publish merge reproduces the
+  build's emission order exactly).
+* EXACTLY-ONCE — kill -9 the follower mid-prepare, mid-publish
+  (between prepare and commit), or mid-rename (after the commit
+  record): a resumed follower re-converges on the exact from-scratch
+  bytes — zero duplicated, zero lost points — because the checkpoint
+  publishes through the same commit journal as the shards.
+* FRESHNESS — a resident `dn serve` (and a cluster member) answers
+  query-after-append byte-identically to a cold from-scratch
+  build + query, with no restart.
+
+Plus rotation/truncation semantics, the --validate dry mode, and the
+/stats `follow` section.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import cli                                # noqa: E402
+from dragnet_tpu import faults as mod_faults               # noqa: E402
+from dragnet_tpu import index_journal as mod_journal       # noqa: E402
+from dragnet_tpu.follow import loop as mod_floop           # noqa: E402
+from dragnet_tpu.serve import server as mod_server         # noqa: E402
+from dragnet_tpu.serve import topology as mod_topology     # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FOLLOW_ENV = {'DN_FOLLOW_LATENCY_MS': '0',
+              'DN_FOLLOW_MAX_BYTES': '2048',
+              'DN_FOLLOW_POLL_MS': '5'}
+
+
+def run_cli(args, env=None):
+    prior = {}
+    for k, v in (env or {}).items():
+        prior[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        with mod_server.thread_stdio() as cap:
+            rc = cli.main(list(args))
+        out, err = cap.finish()
+        return rc, out, err
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _gen(path, n, start=0):
+    import datetime
+    t0 = 1388534400
+    with open(path, 'a' if start else 'w') as f:
+        for i in range(start, start + n):
+            ts = datetime.datetime.utcfromtimestamp(
+                t0 + (i * 997) % (4 * 86400)).strftime(
+                    '%Y-%m-%dT%H:%M:%S.000Z')
+            f.write(json.dumps({
+                'time': ts, 'host': 'h%d' % (i % 3),
+                'operation': ('get', 'put', 'index')[i % 3],
+                'latency': (i * 7) % 100}) + '\n')
+
+
+def _corpus(tmp_path, monkeypatch, n=300):
+    """One data file; per format a follow datasource + a from-scratch
+    reference datasource over the SAME file with separate trees."""
+    datafile = str(tmp_path / 'data.log')
+    _gen(datafile, n)
+    monkeypatch.setenv('DRAGNET_CONFIG', str(tmp_path / 'rc.json'))
+    ctx = {'datafile': datafile, 'n': n, 'idx': {}, 'ref_idx': {}}
+    for fmt in ('dnc', 'sqlite'):
+        for tag, store in (('f', 'idx'), ('r', 'ref_idx')):
+            ds = '%s_%s' % (tag, fmt)
+            idx = str(tmp_path / ('idx_%s_%s' % (tag, fmt)))
+            assert run_cli(['datasource-add', '--path', datafile,
+                            '--index-path', idx, '--time-field',
+                            'time', ds])[0] == 0
+            assert run_cli(['metric-add', '-b',
+                            'timestamp[date,field=time,'
+                            'aggr=lquantize,step=86400],host',
+                            ds, 'm1'])[0] == 0
+            assert run_cli(['metric-add', '-b',
+                            'host,latency[aggr=quantize]', '-f',
+                            '{"eq": ["operation", "get"]}',
+                            ds, 'm2'])[0] == 0
+            ctx[store][fmt] = idx
+    return ctx
+
+
+def _tree_bytes(idx):
+    """Every shard's bytes, relative path keyed — follow state and
+    quarantine excluded (they are not part of the query contract)."""
+    out = {}
+    for r, dirs, names in os.walk(idx):
+        for skip in (mod_journal.FOLLOW_DIR, mod_journal.QUARANTINE_DIR):
+            if skip in dirs:
+                dirs.remove(skip)
+        for name in sorted(names):
+            p = os.path.join(r, name)
+            with open(p, 'rb') as f:
+                out[os.path.relpath(p, idx)] = f.read()
+    return out
+
+
+def _no_litter(idx):
+    bad = []
+    for r, dirs, names in os.walk(idx):
+        for skip in (mod_journal.FOLLOW_DIR, mod_journal.QUARANTINE_DIR):
+            if skip in dirs:
+                dirs.remove(skip)
+        bad.extend(os.path.join(r, n) for n in names
+                   if mod_journal.is_index_litter(n))
+    return bad
+
+
+def _follow_once(fmt, env=None):
+    e = dict(FOLLOW_ENV, DN_INDEX_FORMAT=fmt)
+    e.update(env or {})
+    return run_cli(['follow', '--once', 'f_' + fmt], env=e)
+
+
+def _rebuild_ref(ctx, fmt):
+    shutil.rmtree(ctx['ref_idx'][fmt], ignore_errors=True)
+    assert run_cli(['build', 'r_' + fmt],
+                   env={'DN_INDEX_FORMAT': fmt})[0] == 0
+
+
+def _assert_trees_equal(ctx, fmt, tag):
+    mod_journal.reset_sweep_memo()
+    _rebuild_ref(ctx, fmt)
+    got = _tree_bytes(ctx['idx'][fmt])
+    ref = _tree_bytes(ctx['ref_idx'][fmt])
+    assert sorted(got) == sorted(ref), (tag, sorted(got), sorted(ref))
+    diff = [k for k in ref if got[k] != ref[k]]
+    assert diff == [], '%s: shard bytes diverge: %s' % (tag, diff)
+    assert _no_litter(ctx['idx'][fmt]) == []
+
+
+# -- validate dry mode -----------------------------------------------------
+
+def test_follow_validate(tmp_path, monkeypatch):
+    ctx = _corpus(tmp_path, monkeypatch, n=10)
+    rc, out, err = run_cli(['follow', '--validate', 'f_dnc'],
+                           env=dict(FOLLOW_ENV))
+    assert rc == 0, err
+    text = out.decode()
+    assert 'follow config ok: latency_ms=0 max_bytes=2048 ' \
+        'poll_ms=5' in text
+    assert 'follow plan: datasource=f_dnc interval=day' in text
+    assert ctx['datafile'] in text
+
+    monkeypatch.setenv('DN_FOLLOW_LATENCY_MS', 'nope')
+    rc, out, err = run_cli(['follow', '--validate', 'f_dnc'])
+    assert rc == 1
+    assert b'DN_FOLLOW_LATENCY_MS' in err
+
+    monkeypatch.delenv('DN_FOLLOW_LATENCY_MS', raising=False)
+    rc, out, err = run_cli(['follow', '--validate', '--once',
+                            'nosuch'])
+    assert rc == 1 and b'dn:' in err
+
+
+def test_follow_bad_interval_and_sources(tmp_path, monkeypatch):
+    _corpus(tmp_path, monkeypatch, n=5)
+    rc, out, err = run_cli(['follow', '--interval', 'decade',
+                            'f_dnc'])
+    assert rc == 1 and b'interval not supported' in err
+    rc, out, err = run_cli(['follow', 'f_dnc', '-', '-'])
+    assert rc == 2   # usage: stdin at most once
+
+
+# -- byte-equality ---------------------------------------------------------
+
+@pytest.mark.parametrize('fmt', ['dnc', 'sqlite'])
+def test_follow_once_byte_equals_build(tmp_path, monkeypatch, fmt):
+    """A fresh follow over an existing file produces byte-identical
+    shards to `dn build` — through many mini-batches (2 KiB budget),
+    which exercises the read-modify-publish merge on every shard."""
+    ctx = _corpus(tmp_path, monkeypatch)
+    assert _follow_once(fmt)[0] == 0
+    _assert_trees_equal(ctx, fmt, 'initial')
+
+    # incremental: append + re-follow, twice, always byte-equal
+    for round_ in range(2):
+        _gen(ctx['datafile'], 150, start=ctx['n'])
+        ctx['n'] += 150
+        assert _follow_once(fmt)[0] == 0
+        _assert_trees_equal(ctx, fmt, 'incremental %d' % round_)
+
+
+@pytest.mark.parametrize('interval', ['hour', 'all'])
+def test_follow_other_intervals(tmp_path, monkeypatch, interval):
+    ctx = _corpus(tmp_path, monkeypatch, n=200)
+    e = dict(FOLLOW_ENV, DN_INDEX_FORMAT='dnc')
+    assert run_cli(['follow', '--once', '-i', interval, 'f_dnc'],
+                   env=e)[0] == 0
+    _gen(ctx['datafile'], 100, start=200)
+    assert run_cli(['follow', '--once', '-i', interval, 'f_dnc'],
+                   env=e)[0] == 0
+    mod_journal.reset_sweep_memo()
+    shutil.rmtree(ctx['ref_idx']['dnc'], ignore_errors=True)
+    assert run_cli(['build', '-i', interval, 'r_dnc'],
+                   env={'DN_INDEX_FORMAT': 'dnc'})[0] == 0
+    got = _tree_bytes(ctx['idx']['dnc'])
+    ref = _tree_bytes(ctx['ref_idx']['dnc'])
+    assert got == ref
+
+
+def test_follow_holds_partial_final_line(tmp_path, monkeypatch):
+    """A file ending mid-line: the partial is HELD at stop (it may
+    still be mid-write) and the checkpoint stays on the last line
+    boundary — a checkpoint past a partial could never resume
+    exactly.  Once the line completes, a re-follow ingests it
+    exactly once and the tree equals a build over the whole file."""
+    ctx = _corpus(tmp_path, monkeypatch, n=50)
+    boundary = os.path.getsize(ctx['datafile'])
+    with open(ctx['datafile'], 'a') as f:
+        f.write('{"time": "2014-01-02T03:04:05.000Z", "host": "hZ"')
+    assert _follow_once('dnc')[0] == 0
+    from dragnet_tpu.follow.checkpoint import Checkpointer
+    doc = Checkpointer(ctx['idx']['dnc']).load()
+    assert doc['sources'][0]['offset'] == boundary
+    # the writer completes the record: exactly one more line lands
+    with open(ctx['datafile'], 'a') as f:
+        f.write(', "operation": "get", "latency": 7}\n')
+    assert _follow_once('dnc')[0] == 0
+    _assert_trees_equal(ctx, 'dnc', 'completed tail')
+    doc = Checkpointer(ctx['idx']['dnc']).load()
+    assert doc['sources'][0]['offset'] == \
+        os.path.getsize(ctx['datafile'])
+
+
+# -- rotation / truncation -------------------------------------------------
+
+def test_follow_rotation(tmp_path, monkeypatch):
+    """Rename-rotation between runs: the checkpoint identity no longer
+    matches, the new file ingests from 0, and the tree equals a build
+    over concat(old, new)."""
+    ctx = _corpus(tmp_path, monkeypatch, n=120)
+    assert _follow_once('dnc')[0] == 0
+    os.rename(ctx['datafile'], ctx['datafile'] + '.1')
+    _gen(ctx['datafile'], 80)
+    assert _follow_once('dnc')[0] == 0
+
+    concat = str(tmp_path / 'concat.log')
+    with open(concat, 'wb') as f:
+        for p in (ctx['datafile'] + '.1', ctx['datafile']):
+            with open(p, 'rb') as g:
+                f.write(g.read())
+    assert run_cli(['datasource-update', '--path', concat,
+                    'r_dnc'])[0] == 0
+    mod_journal.reset_sweep_memo()
+    _rebuild_ref(ctx, 'dnc')
+    assert _tree_bytes(ctx['idx']['dnc']) == \
+        _tree_bytes(ctx['ref_idx']['dnc'])
+
+
+def test_follow_live_rotation_and_truncation(tmp_path, monkeypatch):
+    """The tailer units: rotation mid-run drains the old file first;
+    in-place truncation restarts at 0 and drops the held partial."""
+    from dragnet_tpu.follow.tailer import SourceTailer
+    path = str(tmp_path / 'live.log')
+    with open(path, 'w') as f:
+        f.write('one\ntwo\npart')
+    t = SourceTailer(path, chunk_size=64)
+    assert t.poll() == b'one\ntwo\n'
+    assert t.line_off == 8 and t.read_off == 12
+    # rotation: move the file away, write a replacement
+    os.rename(path, path + '.1')
+    with open(path, 'w') as f:
+        f.write('three\n')
+    buf = t.poll()
+    # old tail flushes as a final record, then the new file from 0
+    assert buf == b'part\nthree\n'
+    assert t.line_off == 6          # offsets now track the NEW file
+    # truncation in place: same inode, size below our position
+    with open(path, 'r+') as f:
+        f.truncate(0)
+    with open(path, 'w') as f:
+        f.write('four\n')
+    assert t.poll() in (b'four\n', b'')   # may need one extra poll
+    if t.line_off != 5:
+        assert t.poll() == b'four\n'
+    assert t.line_off == 5
+
+
+# -- exactly-once across kill -9 -------------------------------------------
+
+KILL_SPECS = [
+    'sink.flush:kill:1.0',      # mid-prepare: rollback, re-ingest
+    'follow.publish:kill:1.0',  # between prepare and commit: rollback
+    'sink.rename:kill:1.0',     # post-commit: roll-forward
+]
+
+
+@pytest.mark.parametrize('spec', KILL_SPECS)
+def test_follow_kill9_exactly_once(tmp_path, monkeypatch, spec):
+    """SIGKILL a follower subprocess at each phase of its publish;
+    a resumed follower must land the tree on the exact from-scratch
+    bytes — zero duplicated, zero lost points."""
+    ctx = _corpus(tmp_path, monkeypatch, n=200)
+    assert _follow_once('dnc')[0] == 0
+
+    _gen(ctx['datafile'], 150, start=200)
+    ctx['n'] = 350
+    env = dict(os.environ, DN_FAULTS=spec, JAX_PLATFORMS='cpu',
+               DN_INDEX_FORMAT='dnc', **FOLLOW_ENV)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+         'follow', '--once', 'f_dnc'], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=240)
+    assert proc.returncode == -9, (proc.returncode, proc.stderr)
+
+    mod_faults.reset()
+    mod_journal.reset_sweep_memo()
+    assert _follow_once('dnc')[0] == 0
+    _assert_trees_equal(ctx, 'dnc', 'kill [%s]' % spec)
+
+
+# -- stdin ingest ----------------------------------------------------------
+
+def test_follow_stdin(tmp_path, monkeypatch):
+    ctx = _corpus(tmp_path, monkeypatch, n=60)
+
+    class _Stdin(object):
+        def __init__(self, path):
+            self.buffer = open(path, 'rb')
+    fake = _Stdin(ctx['datafile'])
+    monkeypatch.setattr(sys, 'stdin', fake)
+    try:
+        rc, out, err = run_cli(['follow', '--once', 'f_dnc', '-'],
+                               env=dict(FOLLOW_ENV,
+                                        DN_INDEX_FORMAT='dnc'))
+    finally:
+        fake.buffer.close()
+    assert rc == 0, err
+    _assert_trees_equal(ctx, 'dnc', 'stdin')
+
+
+# -- telemetry -------------------------------------------------------------
+
+def test_follow_stats_and_prom(tmp_path, monkeypatch):
+    """After an in-process follow, `dn stats` carries the `follow`
+    section, the follow_* metrics export via Prometheus, and a
+    resident server's /stats embeds the same section."""
+    ctx = _corpus(tmp_path, monkeypatch, n=80)
+    assert _follow_once('dnc')[0] == 0
+
+    doc = mod_floop.stats_doc()
+    assert doc is not None
+    assert doc['batches_published'] >= 1
+    assert doc['records'] == 80
+    assert doc['seq'] >= 1
+    assert doc['checkpoint_age_s'] is not None
+    assert doc['sources'][0]['path'] == ctx['datafile']
+    assert doc['sources'][0]['offset'] == \
+        os.path.getsize(ctx['datafile'])
+
+    rc, out, err = run_cli(['stats'])
+    assert rc == 0, err
+    stats = json.loads(out.decode())
+    assert 'follow' in stats
+    assert stats['follow']['batches_published'] >= 1
+    assert 'follow_batches_total' in stats['counters']
+    assert 'follow_ingest_lag_ms' in stats['gauges']
+    assert any(k.startswith('follow_append_to_queryable_ms')
+               for k in stats['histograms'])
+
+    rc, out, err = run_cli(['stats', '--prom'])
+    assert rc == 0
+    assert b'dn_follow_batches_total' in out
+    assert b'dn_follow_source_offset' in out
+
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf={'max_inflight': 2, 'queue_depth': 4, 'deadline_ms': 0,
+              'coalesce': True, 'drain_s': 5}).start()
+    try:
+        from dragnet_tpu.serve import client as mod_client
+        sdoc = mod_client.stats(sock, timeout_s=30.0)
+        assert sdoc.get('follow', {}).get('batches_published') >= 1
+    finally:
+        srv.stop()
+
+
+# -- query-after-append through a live server ------------------------------
+
+def _serve_conf():
+    return {'max_inflight': 4, 'queue_depth': 16, 'deadline_ms': 0,
+            'coalesce': True, 'drain_s': 10}
+
+
+def _subprocess_follow(fmt='dnc'):
+    env = dict(os.environ, JAX_PLATFORMS='cpu', DN_INDEX_FORMAT=fmt,
+               **FOLLOW_ENV)
+    env.pop('DN_FAULTS', None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+         'follow', '--once', 'f_' + fmt], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-500:]
+
+
+def test_serve_query_after_append(tmp_path, monkeypatch):
+    """A resident `dn serve` answers query-after-append with bytes
+    identical to a cold from-scratch build + query — no restart.  The
+    follower runs in a SEPARATE process: freshness crosses processes
+    via shard stat identity, not in-process hooks."""
+    ctx = _corpus(tmp_path, monkeypatch, n=150)
+    monkeypatch.setenv('DN_SWEEP_TTL_MS', '0')
+    monkeypatch.setenv('DN_IQ_STAT_TTL_MS', '0')
+    _subprocess_follow()
+
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(socket_path=sock,
+                              conf=_serve_conf()).start()
+    try:
+        case = ['query', '-b', 'host', 'f_dnc']
+        warm = run_cli(case[:1] + ['--remote', sock] + case[1:])
+        assert warm[0] == 0, warm[2]
+
+        _gen(ctx['datafile'], 120, start=150)
+        ctx['n'] = 270
+        _subprocess_follow()
+
+        got = run_cli(case[:1] + ['--remote', sock] + case[1:])
+        assert got[0] == 0, got[2]
+        assert got[1] != warm[1], 'append must change the result'
+        # cold truth: from-scratch build + local query
+        mod_journal.reset_sweep_memo()
+        _rebuild_ref(ctx, 'dnc')
+        ref = run_cli(['query', '-b', 'host', 'r_dnc'])
+        assert ref[0] == 0
+        assert got[1] == ref[1], \
+            'served query-after-append diverges from cold build+query'
+    finally:
+        srv.stop()
+
+
+def test_cluster_member_query_after_append(tmp_path, monkeypatch):
+    """Same freshness contract through a PR 8 cluster member: routed
+    query-after-append byte-equals the cold build + query."""
+    ctx = _corpus(tmp_path, monkeypatch, n=150)
+    monkeypatch.setenv('DN_SWEEP_TTL_MS', '0')
+    monkeypatch.setenv('DN_IQ_STAT_TTL_MS', '0')
+    monkeypatch.setenv('DN_ROUTER_PROBE_MS', '60000')
+    _subprocess_follow()
+
+    socks = {m: str(tmp_path / ('dn-%s.sock' % m)) for m in 'ab'}
+    topo_path = str(tmp_path / 'topo.json')
+    with open(topo_path, 'w') as f:
+        json.dump({
+            'epoch': 1, 'assign': 'hash',
+            'members': {m: {'endpoint': socks[m]} for m in socks},
+            'partitions': [
+                {'id': 0, 'replicas': ['a', 'b']},
+                {'id': 1, 'replicas': ['b', 'a']},
+            ],
+        }, f)
+    servers = {}
+    for m in 'ab':
+        topo = mod_topology.load_topology(topo_path, member=m)
+        servers[m] = mod_server.DnServer(
+            socket_path=socks[m], conf=_serve_conf(), cluster=topo,
+            member=m).start()
+    try:
+        case = ['query', '-b', 'host', 'f_dnc']
+        warm = run_cli(case[:1] + ['--remote', socks['a']] + case[1:])
+        assert warm[0] == 0, warm[2]
+
+        _gen(ctx['datafile'], 120, start=150)
+        ctx['n'] = 270
+        _subprocess_follow()
+
+        got = run_cli(case[:1] + ['--remote', socks['a']] + case[1:])
+        assert got[0] == 0, got[2]
+        mod_journal.reset_sweep_memo()
+        _rebuild_ref(ctx, 'dnc')
+        ref = run_cli(['query', '-b', 'host', 'r_dnc'])
+        assert got[1] == ref[1], \
+            'routed query-after-append diverges from cold build+query'
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+# -- fault seams -----------------------------------------------------------
+
+def test_follow_error_faults_retry_clean(tmp_path, monkeypatch):
+    """error-kind faults at the follow seams: the batch retries and
+    the run still converges byte-exactly (nothing lands twice)."""
+    ctx = _corpus(tmp_path, monkeypatch, n=120)
+    mod_faults.reset()
+    monkeypatch.setenv(
+        'DN_FAULTS',
+        'follow.read:error:0.1:7,follow.checkpoint:error:0.2:8,'
+        'follow.publish:error:0.2:9')
+    rc, out, err = _follow_once('dnc')
+    monkeypatch.delenv('DN_FAULTS')
+    mod_faults.reset()
+    assert rc == 0, err
+    _assert_trees_equal(ctx, 'dnc', 'error faults')
+
+
+@pytest.mark.parametrize('fmt', ['dnc', 'sqlite'])
+def test_follow_post_commit_error_retry_exact(tmp_path, monkeypatch,
+                                              fmt):
+    """An in-process failure AFTER the commit record (every sink
+    rename blows up): the retry must complete the landed intent and
+    skip the batch via the checkpoint seq — re-merging over the
+    half-renamed tree would double-count its points."""
+    ctx = _corpus(tmp_path, monkeypatch, n=150)
+    mod_faults.reset()
+    monkeypatch.setenv('DN_FAULTS', 'sink.rename:error:1.0')
+    rc, out, err = _follow_once(fmt)
+    monkeypatch.delenv('DN_FAULTS')
+    mod_faults.reset()
+    assert rc == 0, err
+    _assert_trees_equal(ctx, fmt, 'post-commit retry')
+
+
+def test_follow_once_publish_failure_streak_exits(tmp_path,
+                                                  monkeypatch):
+    """--once under a publish seam that ALWAYS fails: the drain retry
+    cap must end the process with rc=1 (batch retained for the next
+    catch-up), never an unbounded retry loop."""
+    _corpus(tmp_path, monkeypatch, n=60)
+    mod_faults.reset()
+    monkeypatch.setenv('DN_FAULTS', 'follow.publish:error:1.0')
+    rc, out, err = _follow_once('dnc')
+    monkeypatch.delenv('DN_FAULTS')
+    mod_faults.reset()
+    assert rc == 1
+    assert b'publish failed' in err
+
+
+def test_follow_once_read_errors_retry_to_eof(tmp_path, monkeypatch):
+    """--once promises "ingest to the sources' current EOF": a poll
+    pass that read nothing because the source ERRORED is not caught
+    up — it must retry, and the final checkpoint must cover the whole
+    file (rc=0 with a short checkpoint would be a silent lost
+    suffix)."""
+    ctx = _corpus(tmp_path, monkeypatch, n=120)
+    mod_faults.reset()
+    monkeypatch.setenv('DN_FAULTS', 'follow.read:error:0.4:31')
+    rc, out, err = _follow_once('dnc')
+    monkeypatch.delenv('DN_FAULTS')
+    mod_faults.reset()
+    assert rc == 0, err
+    from dragnet_tpu.follow.checkpoint import Checkpointer
+    doc = Checkpointer(ctx['idx']['dnc']).load()
+    assert doc['sources'][0]['offset'] == \
+        os.path.getsize(ctx['datafile'])
+    _assert_trees_equal(ctx, 'dnc', 'transient read faults')
+
+
+def test_follow_once_persistent_read_error_exits_nonzero(
+        tmp_path, monkeypatch):
+    """--once over a source that can never be read: a bounded retry
+    streak then rc=1 — never rc=0 claiming caught-up with nothing
+    ingested."""
+    _corpus(tmp_path, monkeypatch, n=40)
+    mod_faults.reset()
+    monkeypatch.setenv('DN_FAULTS', 'follow.read:error:1.0')
+    rc, out, err = _follow_once('dnc')
+    monkeypatch.delenv('DN_FAULTS')
+    mod_faults.reset()
+    assert rc == 1
+    assert b'giving up' in err
+
+
+def test_rotation_tail_survives_open_failure(tmp_path, monkeypatch):
+    """The rotated-away file's flushed final record must not be lost
+    when the NEW file's open fails transiently — the tail returns to
+    the caller and the next poll retries the open."""
+    from dragnet_tpu.follow import tailer as mod_tailer
+    path = str(tmp_path / 'rot.log')
+    with open(path, 'w') as f:
+        f.write('one\npart')
+    t = mod_tailer.SourceTailer(path, chunk_size=64)
+    assert t.poll() == b'one\n'
+    os.rename(path, path + '.1')
+    with open(path, 'w') as f:
+        f.write('two\n')
+    orig = mod_tailer.SourceTailer.open_at
+
+    def flaky(self, offset=0):
+        raise mod_tailer.DNError('transient open failure')
+    monkeypatch.setattr(mod_tailer.SourceTailer, 'open_at', flaky)
+    assert t.poll() == b'part\n'         # the tail, not an exception
+    monkeypatch.setattr(mod_tailer.SourceTailer, 'open_at', orig)
+    assert t.poll() == b'two\n'          # recovered on the new file
+
+
+def test_stdin_tailer_pipe_does_not_block(tmp_path, monkeypatch):
+    """An idle pipe must not wedge poll(): bytes short of the chunk
+    size return immediately (select + os.read), an empty pipe
+    returns b'', and EOF flushes through flush_tail."""
+    from dragnet_tpu.follow.tailer import SourceTailer
+    r, w = os.pipe()
+
+    class _Stdin(object):
+        def __init__(self, fd):
+            self.buffer = os.fdopen(fd, 'rb')
+    fake = _Stdin(r)
+    monkeypatch.setattr(sys, 'stdin', fake)
+    try:
+        t = SourceTailer('-', chunk_size=1 << 20)
+        assert t.poll() == b''               # idle pipe: no block
+        os.write(w, b'a\nb')
+        assert t.poll() == b'a\n'            # partial held
+        assert t.line_off == 2 and t.read_off == 3
+        os.write(w, b'2\n')
+        assert t.poll() == b'b2\n'
+        os.close(w)
+        assert t.poll() == b''
+        assert t.eof
+        assert t.flush_tail() == b''
+    finally:
+        fake.buffer.close()

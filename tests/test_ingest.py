@@ -103,3 +103,69 @@ def test_iter_lines_feeds_records(tmp_path):
         mod_ingest.iter_lines([str(p)], chunk_size=13), 'json'))
     assert [f for f, v in got] == recs
     assert all(v == 1 for f, v in got)
+
+
+# -- LineAssembler: the tail-case chunk-boundary joiner -------------------
+
+def test_line_assembler_holds_partial_lines():
+    """A chunk ending mid-line is HELD — never emitted truncated —
+    until more bytes arrive or the caller flushes (EOF-at-stop)."""
+    asm = mod_ingest.LineAssembler()
+    assert asm.feed(b'{"a": 1') == b''
+    assert asm.pending() == 7
+    assert asm.feed(b'}\n{"b":') == b'{"a": 1}\n'
+    assert asm.pending() == 5
+    assert asm.feed(b' 2}') == b''
+    assert asm.pending() == 8
+    assert asm.flush() == b'{"b": 2}'
+    assert asm.pending() == 0
+    assert asm.flush() == b''
+
+
+def test_line_assembler_boundary_fuzz():
+    """Every chunking of a corpus yields the same complete lines, and
+    at every prefix only COMPLETE lines have been emitted (the tail
+    invariant `dn follow` depends on) — the chunk-boundary fuzz the
+    byteparse suite runs, applied to the incremental joiner."""
+    import random
+    rng = random.Random(42)
+    corpus = b''.join(
+        json.dumps({'i': i, 's': 'x' * (i % 37)}).encode() + b'\n'
+        for i in range(120))
+    corpus += b'{"partial": tr'          # unterminated tail
+    for trial in range(40):
+        asm = mod_ingest.LineAssembler()
+        emitted = b''
+        pos = 0
+        while pos < len(corpus):
+            cut = min(len(corpus), pos + rng.randint(1, 61))
+            emitted += asm.feed(corpus[pos:cut])
+            # invariant: everything emitted so far is whole lines,
+            # and emitted + held == consumed bytes
+            assert emitted.endswith(b'\n') or emitted == b''
+            assert emitted + b''.join(asm._tail) == corpus[:cut]
+            pos = cut
+        emitted += asm.flush()
+        assert emitted == corpus, trial
+
+
+def test_line_assembler_matches_batch_joiners():
+    """One implementation, three consumers: the incremental assembler
+    must agree with iter_chunk_lines / iter_line_buffers for any
+    chunking (they are now built on it)."""
+    import random
+    rng = random.Random(7)
+    corpus = (b'\n\na\nbb\n' + b'c' * 100 + b'\nlast-no-newline')
+    for trial in range(25):
+        chunks = []
+        pos = 0
+        while pos < len(corpus):
+            cut = min(len(corpus), pos + rng.randint(1, 17))
+            chunks.append(corpus[pos:cut])
+            pos = cut
+        lines = list(mod_ingest.iter_chunk_lines(iter(chunks)))
+        assert lines == corpus.split(b'\n'), trial
+        bufs = list(mod_ingest.iter_line_buffers(iter(chunks)))
+        assert b''.join(bufs) == corpus
+        for b in bufs[:-1]:
+            assert b.endswith(b'\n')

@@ -28,6 +28,15 @@ the single-process run whenever any replica survives, the clean
 degraded-or-error contract (missing partitions NAMED, never a hang,
 traceback, or silently short bytes) when none does, and
 breaker/failover counters visible in /stats.
+
+`--follow` runs the continuous-ingest drill instead (`make
+soak-follow`): an appender subprocess grows a log while a `dn follow`
+daemon subprocess tails it under armed
+follow.read/checkpoint/publish faults; the follower is SIGKILLed
+mid-batch (externally and via kill-kind faults at the publish seams),
+restarted, and caught up — after EVERY kill the index tree must
+byte-equal a from-scratch `dn build` over the exact checkpointed
+input prefix (zero duplicated, zero lost points), with no litter.
 """
 
 import argparse
@@ -749,6 +758,377 @@ def soak_cluster(root, fast=False, verbose=True, floor=None):
     return s.summary()
 
 
+# -- continuous-ingest (dn follow) drill ------------------------------------
+
+# the appender: grows the log in fsynced bursts so the follower's
+# reads race real in-flight writes (partial trailing lines included)
+APPENDER_SRC = r'''
+import datetime, json, os, sys, time
+path, total, per, sleep_ms = (sys.argv[1], int(sys.argv[2]),
+                              int(sys.argv[3]), float(sys.argv[4]))
+t0 = 1388534400
+i = 0
+while i < total:
+    with open(path, 'a') as f:
+        for j in range(per):
+            if i >= total:
+                break
+            ts = datetime.datetime.utcfromtimestamp(
+                t0 + (i * 4999) % (5 * 86400)).strftime(
+                    '%Y-%m-%dT%H:%M:%S.000Z')
+            f.write(json.dumps({
+                'time': ts, 'host': 'host%d' % (i % 4),
+                'operation': ('get', 'put', 'index')[i % 3],
+                'latency': (i * 7) % 230,
+            }, separators=(',', ':')) + '\n')
+            i += 1
+        f.flush()
+        os.fsync(f.fileno())
+    time.sleep(sleep_ms / 1000.0)
+'''
+
+# error-kind chaos the follower runs under the whole drill (it must
+# retry through these without duplicating or losing a point)
+FOLLOW_ERR_SPEC = ('follow.read:error:0.03:61,'
+                   'follow.checkpoint:error:0.2:62,'
+                   'follow.publish:error:0.2:63,'
+                   'sink.flush:error:0.05:64')
+# per-cycle kill placement: None = external SIGKILL at a random
+# moment; the kill-kind specs land the SIGKILL exactly mid-publish
+# (between prepare and commit) and mid-rename (after the commit
+# record) — the two halves of the atomicity argument
+FOLLOW_KILL_CYCLE = (None, 'follow.publish:kill:1.0',
+                     'sink.rename:kill:1.0')
+
+
+class FollowSoak(object):
+    """One format's appender + follower + kill/verify cycles."""
+
+    def __init__(self, root, fmt, verbose=True):
+        self.root = root
+        self.fmt = fmt
+        self.verbose = verbose
+        self.violations = []
+        self.ops = 0
+        self.kills = 0
+        self.follower_faults = 0
+        self.datafile = os.path.join(root, 'follow_data_%s.log' % fmt)
+        self.prefix = os.path.join(root, 'follow_prefix_%s.log' % fmt)
+        self.idx = os.path.join(root, 'idx_follow_%s' % fmt)
+        self.ref_idx = os.path.join(root, 'idx_fref_%s' % fmt)
+        self.ds = 'dsfollow_' + fmt
+        self.ref_ds = 'dsfref_' + fmt
+        self.stderr_log = os.path.join(root, 'follower_%s.log' % fmt)
+        self.proc = None
+        open(self.datafile, 'w').close()
+        for ds, path, idx in ((self.ds, self.datafile, self.idx),
+                              (self.ref_ds, self.prefix,
+                               self.ref_idx)):
+            rc, out, err = run_cli([
+                'datasource-add', '--path', path, '--index-path',
+                idx, '--time-field', 'time', ds])
+            assert rc == 0, err
+            rc, out, err = run_cli([
+                'metric-add', '-b',
+                'timestamp[date,field=time,aggr=lquantize,'
+                'step=86400],host,latency[aggr=quantize]', ds, 'm1'])
+            assert rc == 0, err
+            rc, out, err = run_cli([
+                'metric-add', '-b', 'operation', '-f',
+                '{"eq": ["operation", "get"]}', ds, 'm2'])
+            assert rc == 0, err
+
+    def note(self, msg):
+        if self.verbose:
+            sys.stderr.write('soak: [%s] %s\n' % (self.fmt, msg))
+
+    def violate(self, msg):
+        self.violations.append('[%s] %s' % (self.fmt, msg))
+        sys.stderr.write('soak: VIOLATION: [%s] %s\n'
+                         % (self.fmt, msg))
+
+    def _follow_env(self, extra_spec=None):
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   DN_INDEX_FORMAT=self.fmt,
+                   DN_FOLLOW_LATENCY_MS='50',
+                   DN_FOLLOW_MAX_BYTES='4096',
+                   DN_FOLLOW_POLL_MS='10')
+        spec = FOLLOW_ERR_SPEC
+        if extra_spec:
+            # DN_FAULTS rejects a site armed twice: a kill-kind cycle
+            # spec replaces the base error entry for its site
+            extra_sites = {e.split(':', 1)[0]
+                           for e in extra_spec.split(',')}
+            kept = [e for e in FOLLOW_ERR_SPEC.split(',')
+                    if e.split(':', 1)[0] not in extra_sites]
+            spec = ','.join(kept + [extra_spec])
+        env['DN_FAULTS'] = spec
+        return env
+
+    def spawn_follower(self, extra_spec=None):
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+             'follow', self.ds, self.datafile],
+            env=self._follow_env(extra_spec),
+            stdout=subprocess.DEVNULL,
+            stderr=open(self.stderr_log, 'ab'))
+
+    def kill_follower(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+        if self.proc is not None:
+            self.proc.wait()
+        self.proc = None
+        self.kills += 1
+
+    def count_follower_faults(self):
+        """error-kind firings surface as the follower's retry warnings
+        (one line per injected fault); kill firings as dead
+        processes.  Parsed from the captured stderr."""
+        try:
+            with open(self.stderr_log, 'rb') as f:
+                text = f.read().decode('utf-8', 'replace')
+        except OSError:
+            return
+        self.follower_faults = text.count('injected')
+
+    def catch_up(self):
+        """`dn follow --once` in-process (armed with the error spec
+        via the environment) until it converges — a drain-phase
+        failure streak returns 1 with the batch retained, so another
+        pass continues exactly where it left off."""
+        env = {'DN_INDEX_FORMAT': self.fmt,
+               'DN_FOLLOW_LATENCY_MS': '0',
+               'DN_FOLLOW_MAX_BYTES': '4096',
+               'DN_FOLLOW_POLL_MS': '10',
+               'DN_FAULTS': FOLLOW_ERR_SPEC}
+        for attempt in range(6):
+            rc, out, err = run_cli(['follow', '--once', self.ds],
+                                   env=env)
+            self.ops += 1
+            if rc == 0:
+                return True
+            text = err.decode('utf-8', 'replace')
+            if 'Traceback' in text:
+                self.violate('catch-up traceback: %r' % text[-300:])
+                return False
+        self.violate('catch-up never converged: %r' % text[-300:])
+        return False
+
+    def verify_prefix(self, when, full=False):
+        """THE exactly-once check: the checkpointed offset names the
+        published input prefix; a from-scratch build over exactly
+        that prefix must answer queries byte-identically.  `full`
+        additionally pins the offset to the completed stream's size —
+        without it a follower that silently stopped short of EOF
+        (rc 0, tiny checkpoint) would pass every prefix comparison
+        and the 'zero lost points' gate would be vacuous."""
+        from dragnet_tpu.follow.checkpoint import Checkpointer
+        doc = Checkpointer(self.idx).load()
+        if doc is None:
+            self.violate('%s: no checkpoint after catch-up' % when)
+            return
+        offset = 0
+        for s in doc['sources']:
+            if s.get('path') == self.datafile:
+                offset = int(s.get('offset') or 0)
+        if full:
+            size = os.path.getsize(self.datafile)
+            if offset != size:
+                self.violate('%s: checkpoint offset %d != completed '
+                             'stream size %d (lost suffix)'
+                             % (when, offset, size))
+                return
+        with open(self.datafile, 'rb') as f:
+            blob = f.read(offset)
+        if len(blob) != offset:
+            self.violate('%s: checkpoint offset %d beyond file'
+                         % (when, offset))
+            return
+        with open(self.prefix, 'wb') as f:
+            f.write(blob)
+        import shutil
+        shutil.rmtree(self.ref_idx, ignore_errors=True)
+        mod_journal.reset_sweep_memo()
+        rc, out, err = run_cli(['build', self.ref_ds],
+                               env={'DN_INDEX_FORMAT': self.fmt})
+        self.ops += 1
+        if rc != 0:
+            self.violate('%s: reference build failed: %r'
+                         % (when, err[-300:]))
+            return
+        # DN_IQ_STAT_TTL_MS=0: the soak process is an EXTERNAL
+        # observer of shards the follower subprocess rewrites; the
+        # handle cache's 1 s stat amortization is documented serving
+        # staleness, and a verify must re-stat to see the tree as it
+        # is on disk (a fresh process would)
+        qenv = {'DN_INDEX_FORMAT': self.fmt,
+                'DN_IQ_STAT_TTL_MS': '0'}
+        for case in (['query', '-b', 'host'],
+                     ['query', '-b', 'host,latency[aggr=quantize]',
+                      '--raw'],
+                     ['query', '--points', '-b', 'operation', '-f',
+                      '{"eq": ["operation", "get"]}']):
+            got = run_cli(case + [self.ds], env=qenv)
+            ref = run_cli(case + [self.ref_ds], env=qenv)
+            self.ops += 2
+            if got[0] != 0 or ref[0] != 0 or got[1] != ref[1]:
+                self.violate(
+                    '%s: %s: follow tree diverges from the '
+                    'from-scratch build over the checkpointed '
+                    'prefix' % (when, ' '.join(case)))
+        litter = tree_tmp_litter(self.idx)
+        litter = [p for p in litter
+                  if mod_journal.FOLLOW_DIR not in p]
+        if litter:
+            self.violate('%s: litter after recovery: %s'
+                         % (when, litter))
+
+    def append_burst(self, n):
+        """Synchronously append `n` fresh records (same shape as the
+        appender's, distinct value range) so a kill-spec cycle always
+        has pending input to publish — the racing appender may have
+        finished while an earlier cycle caught up and verified."""
+        import datetime
+        t0 = 1388534400
+        with open(self.datafile, 'a') as f:
+            for _ in range(n):
+                i = self.burst_i
+                self.burst_i += 1
+                ts = datetime.datetime.utcfromtimestamp(
+                    t0 + (i * 4999) % (5 * 86400)).strftime(
+                        '%Y-%m-%dT%H:%M:%S.000Z')
+                f.write(json.dumps({
+                    'time': ts, 'host': 'host%d' % (i % 4),
+                    'operation': ('get', 'put', 'index')[i % 3],
+                    'latency': (i * 7) % 230,
+                }, separators=(',', ':')) + '\n')
+            f.flush()
+            os.fsync(f.fileno())
+
+    def run(self, fast=False):
+        total = 900 if fast else 4000
+        self.burst_i = total
+        appender = subprocess.Popen(
+            [sys.executable, '-c', APPENDER_SRC, self.datafile,
+             str(total), '30', '20' if fast else '30'],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        cycles = 3 if fast else 9
+        try:
+            for i in range(cycles):
+                spec = FOLLOW_KILL_CYCLE[i % len(FOLLOW_KILL_CYCLE)]
+                self.append_burst(120)
+                self.spawn_follower(extra_spec=spec)
+                if spec is None:
+                    time.sleep(0.8 + 0.4 * (i % 3))
+                    self.kill_follower()
+                    self.note('external SIGKILL mid-stream')
+                else:
+                    deadline = time.time() + 120
+                    while time.time() < deadline and \
+                            self.proc.poll() is None:
+                        time.sleep(0.05)
+                    rc = self.proc.poll()
+                    if rc is None:
+                        self.kill_follower()
+                        self.violate('kill spec [%s] never fired'
+                                     % spec)
+                    else:
+                        self.proc = None
+                        self.kills += 1
+                        if rc != -9:
+                            self.violate(
+                                'kill spec [%s]: rc=%s' % (spec, rc))
+                        self.note('fault SIGKILL [%s]' % spec)
+                mod_journal.reset_sweep_memo()
+                mod_faults.reset()
+                if self.catch_up():
+                    self.verify_prefix('kill cycle %d' % i)
+            # pure chaos rounds: append + catch up under the armed
+            # error spec, no kills — volume for the retry paths
+            # (publish/checkpoint/read failures must retry exactly,
+            # never duplicate); verified once at the end
+            rounds = 20 if fast else 60
+            for r in range(rounds):
+                self.append_burst(100)
+                mod_journal.reset_sweep_memo()
+                if not self.catch_up():
+                    break
+            self.verify_prefix('chaos rounds')
+        finally:
+            if appender.poll() is None:
+                appender.kill()
+            appender.wait()
+            self.kill_follower()
+        # final convergence over the completed stream: drain-stop a
+        # live follower (SIGTERM path), then verify the whole file
+        self.spawn_follower()
+        time.sleep(1.0)
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            self.violate('drain-stop hung')
+            self.kill_follower()
+        self.proc = None
+        mod_journal.reset_sweep_memo()
+        mod_faults.reset()
+        if self.catch_up():
+            self.verify_prefix('final', full=True)
+        self.count_follower_faults()
+
+
+def soak_follow(root, fast=False, verbose=True, floor=None):
+    """The continuous-ingest drill; returns the summary dict."""
+    mod_faults.reset()
+    rc_path = os.path.join(root, 'dragnetrc.json')
+    os.environ['DRAGNET_CONFIG'] = rc_path
+    formats = ('dnc',) if fast else FORMATS
+    soaks = []
+    for fmt in formats:
+        s = FollowSoak(root, fmt, verbose=verbose)
+        s.run(fast=fast)
+        soaks.append(s)
+    if floor:
+        # top-up: more append+catch-up chaos rounds until the
+        # injected-fault floor is met (the error rates are
+        # probabilistic; a lucky run must not fail the gate)
+        s = soaks[-1]
+        subproc = sum(x.follower_faults for x in soaks)
+        extra = 0
+        while extra < 300 and subproc + mod_vpipe.global_counters() \
+                .get('faults injected', 0) < floor:
+            s.append_burst(100)
+            mod_journal.reset_sweep_memo()
+            if not s.catch_up():
+                break
+            extra += 1
+        if extra:
+            s.note('%d top-up chaos rounds' % extra)
+            s.verify_prefix('top-up rounds')
+    counters = mod_vpipe.global_counters()
+    inproc = counters.get('faults injected', 0)
+    summary = {
+        'ops': sum(s.ops for s in soaks),
+        'kills': sum(s.kills for s in soaks),
+        'clean_errors': 0,
+        'violations': sum((s.violations for s in soaks), []),
+        'faults_injected_total': inproc + sum(
+            s.follower_faults for s in soaks),
+        'faults_injected_in_process': inproc,
+        'faults_injected_follower': sum(
+            s.follower_faults for s in soaks),
+        'batches_published': counters.get('follow batches published',
+                                          0),
+        'recovery': {
+            k: counters.get(k, 0)
+            for k in ('index recovery rollbacks',
+                      'index recovery rollforwards',
+                      'index tmps quarantined')},
+    }
+    return summary
+
+
 # the in-process mixed-fault spec: every site that can fire without
 # killing the soak process (kill/torn run under the subprocess drills)
 LOCAL_SPEC = ('sink.create:error:0.08:11,sink.flush:error:0.08:12,'
@@ -814,16 +1194,25 @@ def main(argv=None):
     p.add_argument('--cluster', action='store_true',
                    help='run the scatter-gather cluster drill '
                         'instead of the single-process soak')
+    p.add_argument('--follow', action='store_true',
+                   help='run the continuous-ingest (dn follow) '
+                        'drill instead of the single-process soak')
     p.add_argument('--min-faults', type=int, default=None,
                    help='required injected-fault floor '
-                        '(default: 500, or 50 with --fast)')
+                        '(default: 500, or 50 with --fast; the '
+                        'follow drill defaults to 100/20)')
     args = p.parse_args(argv)
+    if args.follow:
+        default_floor = 20 if args.fast else 100
+    else:
+        default_floor = 50 if args.fast else 500
     floor = args.min_faults if args.min_faults is not None \
-        else (50 if args.fast else 500)
+        else default_floor
 
     import tempfile
     t0 = time.time()
-    runner = soak_cluster if args.cluster else soak
+    runner = soak_cluster if args.cluster \
+        else soak_follow if args.follow else soak
     with tempfile.TemporaryDirectory(prefix='dn_soak_') as root:
         summary = runner(root, fast=args.fast, floor=floor)
     summary['elapsed_s'] = round(time.time() - t0, 1)
