@@ -5,8 +5,8 @@
 PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
-    bench-serve bench-cluster bench-follow soak-faults soak-cluster \
-    soak-follow clean parity-matrix
+    bench-serve bench-cluster bench-follow bench-fanin soak-faults \
+    soak-cluster soak-follow soak-overload clean parity-matrix
 
 all: native
 
@@ -81,6 +81,20 @@ soak-follow: native
 # append-to-queryable latency p50/p95 (bench extras JSON)
 bench-follow: native
 	$(PYTHON) bench.py --follow-only
+
+# the overload drill: multi-tenant flood at ~5x capacity against the
+# 3-member cluster with torn-frame/stall/flood faults armed, tenant
+# weights 3:1, and a mid-flood SIGKILL of one member — asserts zero
+# hangs, zero byte-diffs on accepted requests, retry_after_ms on
+# busy/overloaded rejections, fairness within 2x of weights
+soak-overload: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --overload
+
+# high fan-in: pooled persistent multiplexed connections vs
+# dial-per-request p50/p95 on the cluster partial path + shed-rate
+# extras (bench extras JSON)
+bench-fanin: native
+	$(PYTHON) bench.py --fanin-only
 
 # golden byte-parity under every engine (the strongest single seal:
 # host per-record, vectorized, forced device, auto router), then the

@@ -1376,6 +1376,222 @@ def main_follow():
     }))
 
 
+def fanin_bench(tmpdir):
+    """The high fan-in legs (--fanin-only / make bench-fanin):
+    pooled persistent multiplexed connections (protocol v2, pool.py)
+    vs dial-per-request on the cluster partial path — the exact
+    exchange the scatter-gather router pays once per partition per
+    query — plus an overload flood recording the shed rate and the
+    retry_after_ms contract."""
+    import shutil
+    import threading
+    from dragnet_tpu import config as mod_config
+    from dragnet_tpu.serve import client as mod_scl
+    from dragnet_tpu.serve import pool as mod_pool
+    from dragnet_tpu.serve import server as mod_server
+    from dragnet_tpu.serve import topology as mod_topology
+
+    n = int(os.environ.get('DN_BENCH_FANIN_RECORDS', '60000'))
+    days = int(os.environ.get('DN_BENCH_FANIN_DAYS', '30'))
+    reps = int(os.environ.get('DN_BENCH_FANIN_REPS', '80'))
+
+    datafile = os.path.join(tmpdir, 'fanin.log')
+    idx = os.path.join(tmpdir, 'fanin.idx')
+    rc_path = os.path.join(tmpdir, 'fanin_rc.json')
+    sock = os.path.join(tmpdir, 'fanin.sock')
+    topo_path = os.path.join(tmpdir, 'fanin_topo.json')
+    start_ms = 1388534400000
+    gen_to_file(n, datafile, mindate_ms=start_ms,
+                maxdate_ms=start_ms + days * 86400000)
+
+    cfg = mod_config.create_initial_config()
+    cfg = cfg.datasource_add({
+        'name': 'faninbench', 'backend': 'file',
+        'backend_config': {'path': datafile, 'indexPath': idx,
+                           'timeField': 'time'},
+        'filter': None, 'dataFormat': 'json'})
+    for m in METRICS:
+        cfg = cfg.metric_add({'name': m['name'],
+                              'datasource': 'faninbench',
+                              'filter': m.get('filter'),
+                              'breakdowns': m['breakdowns']})
+    mod_config.ConfigBackendLocal(rc_path).save(cfg.serialize())
+    prior_cfg = os.environ.get('DRAGNET_CONFIG')
+    os.environ['DRAGNET_CONFIG'] = rc_path
+
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    ds = make_ds(datafile, idx)
+    ds.build(metrics, 'day')
+
+    with open(topo_path, 'w') as f:
+        json.dump({'epoch': 1, 'assign': 'hash',
+                   'members': {'a': {'endpoint': sock}},
+                   'partitions': [{'id': 0, 'replicas': ['a']}]}, f)
+    topo = mod_topology.load_topology(topo_path, member='a')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf={'max_inflight': 2, 'queue_depth': 4, 'deadline_ms': 0,
+              'coalesce': False, 'drain_s': 10, 'tenant_quota': 2},
+        cluster=topo, member='a').start()
+
+    partial_req = {
+        'op': 'query_partial', 'ds': 'faninbench', 'config': rc_path,
+        'interval': 'day', 'epoch': 1, 'partitions': [0],
+        'queryconfig': {'breakdowns': [
+            {'name': 'host', 'field': 'host'}]},
+    }
+    query_req = {
+        'op': 'query', 'ds': 'faninbench', 'config': rc_path,
+        'interval': 'day',
+        'queryconfig': {'breakdowns': [
+            {'name': 'host', 'field': 'host'}]},
+        'opts': {},
+    }
+
+    def pctl(times):
+        times = sorted(times)
+        return (times[len(times) // 2],
+                times[min(len(times) - 1, int(len(times) * 0.95))])
+
+    def stats_protocol():
+        return mod_scl.stats(sock).get('protocol') or {}
+
+    try:
+        # warm both paths (jit, shard handles, the pooled conn)
+        for pooled in (False, True):
+            rc0, _, out, err = mod_scl.request_bytes(
+                sock, dict(partial_req), timeout_s=300,
+                pooled=pooled)
+            assert rc0 == 0, err
+
+        conns0 = stats_protocol().get('conns_accepted', 0)
+        dial_times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            rc0, _, _, _ = mod_scl.request_bytes(
+                sock, dict(partial_req), timeout_s=300, pooled=False)
+            dial_times.append((time.monotonic() - t0) * 1000)
+            assert rc0 == 0
+        conns_dial = stats_protocol().get('conns_accepted',
+                                          0) - conns0
+
+        conns0 = stats_protocol().get('conns_accepted', 0)
+        pooled_times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            rc0, _, _, _ = mod_scl.request_bytes(
+                sock, dict(partial_req), timeout_s=300, pooled=True)
+            pooled_times.append((time.monotonic() - t0) * 1000)
+            assert rc0 == 0
+        conns_pooled = stats_protocol().get('conns_accepted',
+                                            0) - conns0
+
+        dial_p50, dial_p95 = pctl(dial_times)
+        pooled_p50, pooled_p95 = pctl(pooled_times)
+
+        # overload flood: 16 tenants' worth of concurrent queries
+        # against 2 execution slots — record the shed rate and that
+        # every busy/overloaded rejection carried retry_after_ms
+        flood = {'total': 0, 'ok': 0, 'shed': 0, 'shed_with_hint': 0,
+                 'transport': 0}
+        flock = threading.Lock()
+
+        def flood_worker(tid):
+            for i in range(10):
+                req = dict(query_req, tenant='t%d' % (tid % 4),
+                           deadline_ms=20000)
+                try:
+                    rc0, hd, out, err = mod_scl.request_bytes(
+                        sock, req, timeout_s=60, pooled=True)
+                except Exception:
+                    with flock:
+                        flood['total'] += 1
+                        flood['transport'] += 1
+                    continue
+                with flock:
+                    flood['total'] += 1
+                    if rc0 == 0:
+                        flood['ok'] += 1
+                    else:
+                        flood['shed'] += 1
+                        if hd.get('retry_after_ms') is not None:
+                            flood['shed_with_hint'] += 1
+
+        threads = [threading.Thread(target=flood_worker, args=(t,))
+                   for t in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        pool_stats = mod_pool.get().stats()
+    finally:
+        srv.stop()
+        if prior_cfg is None:
+            os.environ.pop('DRAGNET_CONFIG', None)
+        else:
+            os.environ['DRAGNET_CONFIG'] = prior_cfg
+        shutil.rmtree(idx, ignore_errors=True)
+        os.unlink(datafile)
+
+    shed_rate = flood['shed'] / float(flood['total']) \
+        if flood['total'] else None
+    return {
+        'fanin_records': n,
+        'fanin_reps': reps,
+        'fanin_partial_dial_p50_ms': round(dial_p50, 3),
+        'fanin_partial_dial_p95_ms': round(dial_p95, 3),
+        'fanin_partial_pooled_p50_ms': round(pooled_p50, 3),
+        'fanin_partial_pooled_p95_ms': round(pooled_p95, 3),
+        'fanin_pooled_vs_dial_p50': round(dial_p50 / pooled_p50, 3)
+        if pooled_p50 else None,
+        'fanin_conns_dialed_leg': conns_dial,
+        'fanin_conns_pooled_leg': conns_pooled,
+        'fanin_pool_dials': pool_stats.get('dials'),
+        'fanin_pool_reuses': pool_stats.get('reuses'),
+        'fanin_flood_requests': flood['total'],
+        'fanin_flood_completed': flood['ok'],
+        'fanin_flood_shed': flood['shed'],
+        'fanin_flood_transport': flood['transport'],
+        'fanin_shed_rate': round(shed_rate, 4)
+        if shed_rate is not None else None,
+        'fanin_shed_retry_after_present':
+            flood['shed'] == flood['shed_with_hint'],
+    }
+
+
+def main_fanin():
+    """High fan-in legs only (`make bench-fanin` / --fanin-only)."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_fanin_')
+    try:
+        fb = fanin_bench(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    sys.stderr.write(
+        'bench-fanin: partial p50 pooled %.2fms vs dial %.2fms '
+        '(%.2fx, p95 %.2f vs %.2f); conns %d pooled vs %d dialed; '
+        'flood %d reqs -> %d ok / %d shed / %d transport '
+        '(shed rate %s, retry_after on every shed: %s)\n'
+        % (fb['fanin_partial_pooled_p50_ms'],
+           fb['fanin_partial_dial_p50_ms'],
+           fb['fanin_pooled_vs_dial_p50'] or 0.0,
+           fb['fanin_partial_pooled_p95_ms'],
+           fb['fanin_partial_dial_p95_ms'],
+           fb['fanin_conns_pooled_leg'], fb['fanin_conns_dialed_leg'],
+           fb['fanin_flood_requests'], fb['fanin_flood_completed'],
+           fb['fanin_flood_shed'], fb['fanin_flood_transport'],
+           fb['fanin_shed_rate'],
+           fb['fanin_shed_retry_after_present']))
+    print(json.dumps({
+        'metric': 'fanin_partial_pooled_p50_ms',
+        'value': fb['fanin_partial_pooled_p50_ms'],
+        'unit': 'ms',
+        'vs_baseline': fb['fanin_pooled_vs_dial_p50'],
+        'extra': fb,
+    }))
+
+
 def main_parse():
     """Parse-lane legs only (`make bench-parse` / --parse-only):
     host-record vs native vs vector vs device parse MB/s plus
@@ -1507,6 +1723,9 @@ def main():
     if '--follow-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'follow':
         return main_follow()
+    if '--fanin-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'fanin':
+        return main_fanin()
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)

@@ -1082,10 +1082,23 @@ def cmd_serve(ctx, argv):
                conf['deadline_ms'], 1 if conf['coalesce'] else 0,
                conf['drain_s']))
         sys.stdout.write(
+            'serve front-end ok: read_deadline_ms=%d '
+            'write_deadline_ms=%d idle_ms=%d\n'
+            % (conf['read_deadline_ms'], conf['write_deadline_ms'],
+               conf['idle_ms']))
+        sys.stdout.write(
+            'serve tenancy ok: quota=%d default_weight=%d '
+            'weights=%s\n'
+            % (conf['tenant_quota'], conf['tenant_default_weight'],
+               ','.join('%s:%d' % (n, w) for n, w in
+                        sorted(conf['tenant_weights'].items()))
+               or 'none'))
+        sys.stdout.write(
             'remote config ok: retries=%d backoff_ms=%d '
-            'connect_timeout_s=%d\n'
+            'connect_timeout_s=%d deadline_ms=%d\n'
             % (remote_conf['retries'], remote_conf['backoff_ms'],
-               remote_conf['connect_timeout_s']))
+               remote_conf['connect_timeout_s'],
+               remote_conf['deadline_ms']))
         sys.stdout.write(
             'obs config ok: trace=%s slow_ms=%s buckets=%d\n'
             % (obs_conf['trace'] or 'off',

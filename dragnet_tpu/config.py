@@ -322,13 +322,56 @@ _SERVE_KNOBS = [
     ('DN_SERVE_COALESCE', 'bool', True, None),
     # how long a SIGTERM/SIGINT drain waits for in-flight requests
     ('DN_SERVE_DRAIN_S', 'int', 30, 0),
+    # connection-front-end deadlines (serve/ioloop.py): a PARTIAL
+    # request line older than this is reaped (the slow-loris bound);
+    # 0 disables
+    ('DN_SERVE_READ_DEADLINE_MS', 'int', 10000, 0),
+    # a queued-but-unflushed response older than this closes the
+    # connection (the slow-reader bound); 0 disables
+    ('DN_SERVE_WRITE_DEADLINE_MS', 'int', 60000, 0),
+    # a connection with no traffic and no in-flight work for this
+    # long is closed (pooled peers just re-dial); 0 disables
+    ('DN_SERVE_IDLE_MS', 'int', 300000, 0),
+    # per-tenant queued-request cap (admission.py weighted-fair
+    # queues); 0 = no per-tenant cap (the global DN_SERVE_QUEUE_DEPTH
+    # still binds)
+    ('DN_SERVE_TENANT_QUOTA', 'int', 0, 0),
+    # fair-dequeue weight for tenants not named in
+    # DN_SERVE_TENANT_WEIGHTS
+    ('DN_SERVE_TENANT_DEFAULT_WEIGHT', 'int', 1, 1),
 ]
+
+
+def _parse_tenant_weights(raw):
+    """DN_SERVE_TENANT_WEIGHTS spec: 'name:weight,name:weight,...'
+    with integer weights >= 1.  Returns {name: weight} or DNError."""
+    weights = {}
+    for part in raw.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, w = part.rpartition(':')
+        if not sep or not name:
+            return DNError('DN_SERVE_TENANT_WEIGHTS: expected '
+                           '"name:weight,...", got "%s"' % part)
+        try:
+            weight = int(w)
+        except ValueError:
+            weight = 0
+        if weight < 1:
+            return DNError('DN_SERVE_TENANT_WEIGHTS: weight for '
+                           '"%s" must be an integer >= 1, got "%s"'
+                           % (name, w))
+        weights[name] = weight
+    return weights
 
 
 def serve_config(env=None):
     """The resolved DN_SERVE_* knob dict (keys: max_inflight,
-    queue_depth, deadline_ms, coalesce, drain_s), or DNError on the
-    first malformed value — 'DN_SERVE_X: expected ..., got "v"'."""
+    queue_depth, deadline_ms, coalesce, drain_s, read_deadline_ms,
+    write_deadline_ms, idle_ms, tenant_quota, tenant_default_weight,
+    tenant_weights), or DNError on the first malformed value —
+    'DN_SERVE_X: expected ..., got "v"'."""
     if env is None:
         env = os.environ
     rv = {}
@@ -353,6 +396,14 @@ def serve_config(env=None):
             return DNError('%s: expected an integer >= %d, got "%s"'
                            % (name, minimum, raw))
         rv[key] = value
+    raw = env.get('DN_SERVE_TENANT_WEIGHTS')
+    if raw is None or raw == '':
+        rv['tenant_weights'] = {}
+    else:
+        weights = _parse_tenant_weights(raw)
+        if isinstance(weights, DNError):
+            return weights
+        rv['tenant_weights'] = weights
     return rv
 
 
@@ -372,12 +423,17 @@ _REMOTE_KNOBS = [
     # connect() deadline per attempt (the overall request timeout,
     # DN_SERVE_CLIENT_TIMEOUT_S, still governs the exchange)
     ('DN_REMOTE_CONNECT_TIMEOUT_S', 'int', 5, 1),
+    # end-to-end deadline attached to every shipped request (rides
+    # client -> router -> member partials; the server sheds work it
+    # cannot finish inside it); 0 = no deadline attached
+    ('DN_REMOTE_DEADLINE_MS', 'int', 0, 0),
 ]
 
 
 def remote_config(env=None):
     """The resolved DN_REMOTE_* knob dict (keys: retries, backoff_ms,
-    connect_timeout_s), or DNError on the first malformed value."""
+    connect_timeout_s, deadline_ms), or DNError on the first
+    malformed value."""
     if env is None:
         env = os.environ
     rv = {}

@@ -57,6 +57,9 @@ SITES = (
     'serve.accept',     # dn serve: accepted-connection handling
     'serve.read',       # dn serve: request read/parse
     'serve.write',      # dn serve: response write
+    'serve.frame_torn',  # dn serve: v2 response framing (torn frame)
+    'serve.stall',      # dn serve: per-request handling stall
+    'tenant.flood',     # admission: per-tenant enqueue (overload)
     'client.connect',   # remote client: connect()
     'client.send',      # remote client: request send
     'client.recv',      # remote client: response header/payload read

@@ -36,6 +36,7 @@ error with the attempt count, never a bare socket traceback, and
 never a local fallback that might double-run a build).
 """
 
+import io
 import json
 import os
 import random
@@ -47,6 +48,7 @@ from ..errors import DNError
 from .. import faults as mod_faults
 from ..obs import trace as obs_trace
 from ..vpipe import counter_bump
+from . import pool as mod_pool
 
 CHUNK = 1 << 16
 
@@ -163,15 +165,48 @@ def _default_timeout_s():
     return float(os.environ.get('DN_SERVE_CLIENT_TIMEOUT_S', '3600'))
 
 
-def _exchange_with_retry(remote, req, timeout_s, on_header):
+def _retry_delay_s(conf, attempt, header):
+    """Backoff before the next attempt: the server's own
+    retry_after_ms hint when the rejection carried one (±20% jitter —
+    a shed burst must not retry in lockstep), the blind exponential
+    otherwise."""
+    hint = header.get('retry_after_ms') if header else None
+    if hint is None and header:
+        hint = (header.get('stats') or {}).get('retry_after_ms')
+    if hint is not None:
+        try:
+            counter_bump('remote retry-after honored')
+            return max(0.001,
+                       float(hint) / 1000.0 * random.uniform(0.8,
+                                                             1.2))
+        except (TypeError, ValueError):
+            pass
+    return _backoff_s(conf, attempt)
+
+
+def _attempt(remote, req, timeout_s, conf, phase, pooled):
+    """One request attempt: the pooled multiplexed path when the
+    endpoint speaks v2, the dial-per-request path otherwise.
+    Returns (header, response_file, sock_or_None)."""
+    if pooled and not mod_pool.get().is_v1(remote):
+        header, payload = mod_pool.get().exchange(
+            remote, req, timeout_s, conf['connect_timeout_s'], phase)
+        return header, io.BytesIO(payload), None
+    return _open_request(remote, req, timeout_s, conf, phase)
+
+
+def _exchange_with_retry(remote, req, timeout_s, on_header,
+                         pooled=False):
     """The shared retry loop: attempt the request up to
     1 + DN_REMOTE_RETRIES times, backing off between attempts on
     pre-commit transport failures and retryable server rejections
-    (busy/draining).  On a kept response, returns
+    (busy/draining/overloaded — honoring the server's retry_after_ms
+    hint when present).  On a kept response, returns
     on_header(header, f) with the socket managed here.  Raises
     RemoteUnreachable / RemoteRetryExhausted on exhaustion (see
-    module docstring) and RemoteTransportError from on_header's
-    post-commit reads."""
+    module docstring) and RemoteTransportError from post-commit
+    failures.  `pooled` rides the persistent multiplexed connection
+    (pool.py) with transparent v1 fallback."""
     conf = retry_conf()
     attempts = conf['retries'] + 1
     last_err = None
@@ -179,8 +214,10 @@ def _exchange_with_retry(remote, req, timeout_s, on_header):
     for attempt in range(1, attempts + 1):
         phase = {'phase': 'connect'}
         try:
-            header, f, sock = _open_request(remote, req, timeout_s,
-                                            conf, phase)
+            header, f, sock = _attempt(remote, req, timeout_s, conf,
+                                       phase, pooled)
+        except RemoteTransportError:
+            raise                     # post-commit: never retried
         except (OSError, ValueError, mod_faults.FaultInjected) as e:
             last_err = e
             if phase['phase'] != 'connect':
@@ -191,17 +228,21 @@ def _exchange_with_retry(remote, req, timeout_s, on_header):
                 continue
             break
         if header.get('retryable') and attempt < attempts:
-            # busy/draining: the request was never admitted — back
-            # off and try again (the last attempt keeps the server's
-            # error response so the user sees the real message)
-            sock.close()
+            # busy/draining/shed: the request was never admitted —
+            # back off (the server's retry_after_ms when it sent
+            # one) and try again (the last attempt keeps the
+            # server's error response so the user sees the real
+            # message)
+            if sock is not None:
+                sock.close()
             counter_bump('remote retryable rejections')
-            time.sleep(_backoff_s(conf, attempt))
+            time.sleep(_retry_delay_s(conf, attempt, header))
             continue
         try:
             return on_header(header, f)
         finally:
-            sock.close()
+            if sock is not None:
+                sock.close()
     detail = getattr(last_err, 'strerror', None) or str(last_err)
     if reached_server:
         raise RemoteRetryExhausted(
@@ -247,6 +288,7 @@ def request(remote, req, timeout_s=None):
     tctx = obs_trace.current_trace()
     if tctx is not None and 'trace' not in req:
         req = dict(req, trace={'id': tctx.trace_id, 'want': True})
+    req = _annotate(req)
 
     def stream_through(header, f):
         if tctx is not None:
@@ -259,28 +301,66 @@ def request(remote, req, timeout_s=None):
                 _write_bytes(stream, chunk)
         return int(header.get('rc', 1))
 
+    # scans stream UNBOUNDED output (every record): they keep the
+    # dial-per-request path, whose payload flows through in 64K
+    # chunks — the pooled path necessarily buffers a whole response
+    # to demultiplex it, which is fine for query/build/stats-sized
+    # payloads and an OOM hazard for a multi-GB scan
+    pooled = req.get('op') != 'scan'
     with obs_trace.span('remote.exchange', endpoint=str(remote)):
         return _exchange_with_retry(remote, req, timeout_s,
-                                    stream_through)
+                                    stream_through, pooled=pooled)
 
 
-def request_bytes(remote, req, timeout_s=60.0, retry=False):
-    """request() for harnesses and probes: returns (rc, header,
-    stdout_bytes, stderr_bytes) instead of writing through the
-    process streams.  Defaults to a single attempt; pass retry=True
-    for the armored _exchange_with_retry path (health/stats probes
-    do — one transient accept flap must not read as a dead
-    server)."""
+def _annotate(req):
+    """Attach the ambient request envelope: the end-to-end deadline
+    (DN_REMOTE_DEADLINE_MS — the server sheds work it cannot finish
+    inside it, and the router propagates the remaining budget to
+    member partials) and the tenant identity (DN_REMOTE_TENANT —
+    admission fairness keys on it; defaults to peer identity
+    server-side)."""
+    extra = {}
+    if 'deadline_ms' not in req:
+        conf = retry_conf()
+        if conf['deadline_ms'] > 0:
+            extra['deadline_ms'] = conf['deadline_ms']
+    if 'tenant' not in req:
+        tenant = os.environ.get('DN_REMOTE_TENANT')
+        if tenant:
+            extra['tenant'] = tenant
+    return dict(req, **extra) if extra else req
+
+
+def request_bytes(remote, req, timeout_s=60.0, retry=False,
+                  pooled=None):
+    """request() for harnesses, probes, and the router's partials:
+    returns (rc, header, stdout_bytes, stderr_bytes) instead of
+    writing through the process streams.  Defaults to a single
+    attempt; pass retry=True for the armored _exchange_with_retry
+    path (health/stats probes do — one transient accept flap must not
+    read as a dead server).  `pooled` rides the persistent
+    multiplexed connection (defaults to True with retry, False for
+    the raw single-shot dial harnesses depend on)."""
+    if pooled is None:
+        pooled = retry
+    req = _annotate(req)
+
     def buffer_up(header, f):
         out = b''.join(_read_exact(f, header.get('nout', 0)))
         err = b''.join(_read_exact(f, header.get('nerr', 0)))
         return int(header.get('rc', 1)), header, out, err
 
     if retry:
-        return _exchange_with_retry(remote, req, timeout_s, buffer_up)
+        return _exchange_with_retry(remote, req, timeout_s,
+                                    buffer_up, pooled=pooled)
     conf = retry_conf()
+    phase = {'phase': 'connect'}
+    if pooled and not mod_pool.get().is_v1(remote):
+        header, payload = mod_pool.get().exchange(
+            remote, req, timeout_s, conf['connect_timeout_s'], phase)
+        return buffer_up(header, io.BytesIO(payload))
     header, f, sock = _open_request(remote, req, timeout_s, conf,
-                                    {'phase': 'connect'})
+                                    phase)
     try:
         return buffer_up(header, f)
     finally:

@@ -69,12 +69,17 @@ class RouterPartitionError(DNError):
     missing partitions (the `missing_partitions` attribute rides into
     the response header)."""
 
-    def __init__(self, missing, detail):
+    def __init__(self, missing, detail, retry_after_ms=None):
         super(RouterPartitionError, self).__init__(
             'cluster partition(s) unavailable: %s (%s)'
             % (','.join(str(p) for p in missing), detail))
         self.missing_partitions = list(missing)
         self.retryable = True
+        # when the partitions failed because members were SHEDDING
+        # (busy/overloaded, not down), the members' retry hints ride
+        # up to the client — shed != down, and the client should back
+        # off exactly as long as the most loaded member asked
+        self.retry_after_ms = retry_after_ms
 
 
 class _BreakerOpen(Exception):
@@ -413,8 +418,12 @@ class Router(object):
         try:
             with obs_trace.span('router.partial', member=name,
                                 partition=pid):
+                # partials ride the pooled persistent connection (one
+                # socket per member, multiplexed across partitions
+                # and concurrent scatters) — no dial per partial
                 rc, header, out, err = mod_client.request_bytes(
-                    st.endpoint, partial_req, timeout_s=timeout_s)
+                    st.endpoint, partial_req, timeout_s=timeout_s,
+                    pooled=True)
         except (OSError, ValueError, DNError) as e:
             st.breaker.record_failure()
             raise DNError('member "%s"' % name,
@@ -428,7 +437,11 @@ class Router(object):
                 st.breaker.record_failure()
             msg = err.decode('utf-8', 'replace').strip() or \
                 'partial failed'
-            raise DNError('member "%s": %s' % (name, msg))
+            e = DNError('member "%s": %s' % (name, msg))
+            if header.get('retryable'):
+                e.retryable = True
+                e.retry_after_ms = header.get('retry_after_ms')
+            raise e
         st.breaker.record_success()
         try:
             doc = json.loads(out.decode('utf-8'))
@@ -448,6 +461,12 @@ class Router(object):
             mod_faults.fire('router.dispatch')
             ranked = self._rank(self.topo.replicas(pid))
             timeout_s = self.conf['fetch_timeout_s']
+            if partial_req.get('deadline_ms'):
+                # a propagated deadline bounds the fetch too: waiting
+                # longer than the client will cannot help
+                timeout_s = min(
+                    timeout_s,
+                    partial_req['deadline_ms'] / 1000.0 + 1.0)
             resultq = queue.Queue()
             launched = []
 
@@ -544,17 +563,27 @@ class Router(object):
             detail = '; '.join(
                 getattr(e, 'message', None) or str(e)
                 for e in errors[-2:]) or 'no replica reachable'
-            raise DNError('partition %d: all replicas failed '
-                          '(tried %s): %s'
-                          % (pid, ','.join(launched), detail))
+        e = DNError('partition %d: all replicas failed '
+                    '(tried %s): %s'
+                    % (pid, ','.join(launched), detail))
+        hints = [getattr(x, 'retry_after_ms', None) for x in errors]
+        hints = [h for h in hints if h is not None]
+        if hints:
+            e.retry_after_ms = max(hints)
+        raise e
 
     # -- scatter-gather ---------------------------------------------------
 
-    def scatter(self, ds, dsname, query, interval, req):
+    def scatter(self, ds, dsname, query, interval, req,
+                deadline_at=None):
         """Fan `req` (an index query) across every partition and
         merge.  Returns (ScanResult, missing_partition_ids); raises
         RouterPartitionError in DN_ROUTER_PARTIAL=error mode when any
-        partition has no live replica."""
+        partition has no live replica.  `deadline_at` (monotonic) is
+        the routed request's propagated deadline: the REMAINING
+        budget rides every member partial as its deadline_ms, so a
+        member sheds partials it cannot finish in time instead of
+        computing past the client's patience."""
         from ..aggr import Aggregator
         from ..datasource_file import ScanResult
         from ..vpipe import Pipeline
@@ -568,6 +597,14 @@ class Router(object):
             'queryconfig': req.get('queryconfig'),
             'epoch': self.topo.epoch,
         }
+        if req.get('tenant'):
+            # fairness identity rides the hop: a member under load
+            # sheds per originating tenant, not per router
+            partial_req['tenant'] = req['tenant']
+        if deadline_at is not None:
+            remaining_ms = int((deadline_at - time.monotonic())
+                               * 1000.0)
+            partial_req['deadline_ms'] = max(1, remaining_ms)
         scope = mod_vpipe.current_scope()
         results = {}
         failures = {}
@@ -607,8 +644,13 @@ class Router(object):
             self._bump('degraded')
             detail = '; '.join(
                 failures[p].message for p in missing[:2])
+            hints = [getattr(failures[p], 'retry_after_ms', None)
+                     for p in missing]
+            hints = [h for h in hints if h is not None]
             if self.conf['partial'] == 'error':
-                raise RouterPartitionError(missing, detail)
+                raise RouterPartitionError(
+                    missing, detail,
+                    retry_after_ms=max(hints) if hints else None)
             self._bump('partial_responses')
 
         # merge in GLOBAL find order: every member reported its shards

@@ -13,8 +13,9 @@ process lives across requests; this server is that process.  It holds:
   request coalescing (admission.py) turns into one aggregation for N
   compatible concurrent queries.
 
-Protocol: newline-JSON over a unix socket (TCP optional), one request
-per connection.  Request: one JSON line, e.g.
+Protocol: newline-JSON over a unix socket (TCP optional), framed by
+serve/protocol.py.  v1 (legacy, still served byte-identically): one
+request per connection.  Request: one JSON line, e.g.
 
     {"op": "query", "ds": "muskie", "config": "/path/.dragnetrc",
      "queryconfig": {"breakdowns": [...], "filter": ...},
@@ -22,12 +23,26 @@ per connection.  Request: one JSON line, e.g.
 
 Response: one JSON header line {"ok": bool, "rc": int, "nout": N,
 "nerr": M, "stats": {...}} followed by exactly N stdout bytes and M
-stderr bytes.  The payload bytes are BYTE-IDENTICAL to what the local
-CLI command would have written — requests execute through the same
-datasource entry points and the same output layer, with each worker
-thread's stdout/stderr routed to per-request buffers (the thread-stdio
-router below), and coalesced requests demuxed through private
-ScanResult clones.
+stderr bytes.  v2 (negotiated by a `"proto": 2` field plus a request
+`"id"`): the same frames on a PERSISTENT multiplexed connection —
+requests pipeline, responses return out of order tagged with the
+request id, and the connection front end is a selector loop
+(serve/ioloop.py) so idle connections cost no threads and half-dead
+peers are reaped on read/write deadlines.  The payload bytes are
+BYTE-IDENTICAL to what the local CLI command would have written —
+requests execute through the same datasource entry points and the
+same output layer, with each worker thread's stdout/stderr routed to
+per-request buffers (the thread-stdio router below), and coalesced
+requests demuxed through private ScanResult clones.
+
+Overload posture (admission.py): per-tenant weighted-fair admission
+(tenants from the request's `tenant` field, defaulting to peer
+identity), deadline propagation (`deadline_ms` rides client -> router
+-> member partials), and early load shedding — a request whose
+remaining deadline cannot cover the observed service time is rejected
+with a clean retryable error carrying `retry_after_ms` BEFORE it
+occupies an execution slot.  Under N× capacity the server degrades —
+honest 429/503-style rejections — instead of collapsing.
 
 Ops: scan, query, build, stats, ping (+ a `_sleep` debug op when
 DN_SERVE_TEST_OPS=1, used by the lifecycle tests to hold slots).
@@ -56,9 +71,11 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..watchdog import LeakCheck
 from . import admission as mod_admission
+from . import ioloop as mod_ioloop
 from . import lifecycle as mod_lifecycle
+from . import protocol as mod_protocol
 
-MAX_REQUEST_BYTES = 1 << 24
+MAX_REQUEST_BYTES = mod_protocol.MAX_FRAME_BYTES
 
 # a server that exits while `running` never drained: in-flight
 # requests (and their clients) may have been dropped on the floor
@@ -230,7 +247,11 @@ class DnServer(object):
             raise conf
         assert (socket_path is None) != (port is None), \
             'exactly one of socket_path/port'
-        self.conf = conf
+        # embedders (tests, soaks) pass partial conf dicts; the newer
+        # front-end/tenancy knobs fall back to their defaults
+        full = mod_config.serve_config(env={})
+        full.update(conf)
+        self.conf = conf = full
         # cluster mode (`--cluster=TOPOLOGY.json --member=NAME`): this
         # server owns its partitions of the index tree and acts as
         # scatter-gather router for incoming queries (serve/router.py)
@@ -248,13 +269,17 @@ class DnServer(object):
         self.host = host
         self.pidfile = pidfile
         self.bound_port = None
-        self.admission = mod_admission.Admission(conf['max_inflight'],
-                                                 conf['queue_depth'])
+        self.admission = mod_admission.Admission(
+            conf['max_inflight'], conf['queue_depth'],
+            tenant_quota=conf['tenant_quota'],
+            tenant_weights=conf['tenant_weights'],
+            tenant_default_weight=conf['tenant_default_weight'])
         self.coalescer = mod_admission.Coalescer(conf['coalesce'])
         self.log = mod_log.get('serve')
         self.running = False
         self.draining = False
         self._listener = None
+        self.loop = None
         self._stop = threading.Event()
         self._drained = threading.Event()
         self._workers = set()
@@ -263,6 +288,7 @@ class DnServer(object):
         self._counters = {'requests': 0, 'errors': 0,
                           'busy_rejected': 0, 'deadline_expired': 0,
                           'draining_rejected': 0,
+                          'shed_overloaded': 0,
                           'build_idem_replays': 0}
         # build idempotency: key -> {'done': Event, 'result': tuple}.
         # A retried `dn build --remote` (same client-generated key)
@@ -298,9 +324,19 @@ class DnServer(object):
                                 socket.SO_REUSEADDR, 1)
             listener.bind((self.host, self.port))
             self.bound_port = listener.getsockname()[1]
-        listener.listen(128)
-        listener.settimeout(0.25)
+        listener.listen(512)
         self._listener = listener
+        # the selector front end (serve/ioloop.py): accepts, frames,
+        # reaps; workers are spawned per dispatched request
+        self.loop = mod_ioloop.IOLoop(
+            listener,
+            {'read_deadline_ms': self.conf['read_deadline_ms'],
+             'write_deadline_ms': self.conf['write_deadline_ms'],
+             'idle_ms': self.conf['idle_ms']},
+            on_request=self._on_frame,
+            on_overflow=self._on_overflow,
+            on_accept=self._on_accept,
+            log=self.log)
         self.running = True
         _SERVER_LEAKS.track(self)
         self._hook = mod_lifecycle.install_writer_invalidation()
@@ -312,23 +348,13 @@ class DnServer(object):
                       max_inflight=self.conf['max_inflight'])
 
     def serve_forever(self):
-        """Accept loop (blocks until request_stop); drains on exit:
-        stop accepting, finish in-flight, flush caches, unlink the
-        socket."""
+        """Run the selector front end (blocks until request_stop);
+        drains on exit: stop accepting, finish in-flight, flush
+        responses, flush caches, unlink the socket."""
         install_stdio_router()
+        self.loop.start()
         try:
-            while not self._stop.is_set():
-                try:
-                    conn, _ = self._listener.accept()
-                except socket.timeout:
-                    continue
-                except OSError:
-                    break
-                t = threading.Thread(target=self._handle_conn,
-                                     args=(conn,), daemon=True)
-                with self._workers_lock:
-                    self._workers.add(t)
-                t.start()
+            self._stop.wait()
         finally:
             self._drain()
 
@@ -360,10 +386,7 @@ class DnServer(object):
     def _drain(self):
         if self._drained.is_set():
             return
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        self.loop.stop_accepting()
         deadline = time.monotonic() + self.conf['drain_s']
         with self._workers_lock:
             workers = list(self._workers)
@@ -372,6 +395,9 @@ class DnServer(object):
         leftover = sum(1 for t in workers if t.is_alive())
         if leftover:
             self.log.warn('drain grace expired', abandoned=leftover)
+        # flush queued response bytes (the draining rejections the
+        # workers just framed included), then close every connection
+        self.loop.shutdown(max(1.0, deadline - time.monotonic() + 1))
         if self.router is not None:
             self.router.stop()
         # flush warm state cleanly: cached shard handles hold open
@@ -412,6 +438,15 @@ class DnServer(object):
             'draining': self.draining,
             'requests': requests,
             'inflight': self.admission.depth(),
+            # per-tenant fair-admission telemetry: weights, queue
+            # depths, admitted/shed/completed counters, the live
+            # service-time estimate (admission.py)
+            'tenants': self.admission.tenants_doc(),
+            # connection front-end telemetry: open/accepted conns,
+            # v2 negotiation, pipelined frames, reap counters
+            # (serve/ioloop.py)
+            'protocol': self.loop.stats()
+            if self.loop is not None else {},
             'caches': {
                 'shard_handles': mod_iqmt.shard_cache_stats(),
                 'find_memo': mod_iqmt.find_cache_stats(),
@@ -455,67 +490,153 @@ class DnServer(object):
 
     # -- request handling -------------------------------------------------
 
-    def _handle_conn(self, conn):
-        f = None
+    # -- connection front end (loop-thread callbacks) ---------------------
+
+    def _on_accept(self, conn):
+        """Accept veto hook (loop thread): an injected accept fault
+        drops the connection, exactly the failure the client's
+        pre-commit retry loop exists for."""
         try:
             mod_faults.fire('serve.accept')
-            conn.settimeout(60)
-            f = conn.makefile('rb')
-            mod_faults.fire('serve.read')
-            line = f.readline(MAX_REQUEST_BYTES)
-            if not line:
-                return
-            try:
-                req = json.loads(line.decode('utf-8'))
-                if not isinstance(req, dict):
-                    raise ValueError('not an object')
-            except (ValueError, UnicodeDecodeError) as e:
-                self._respond(conn, 1, b'',
-                              ('dn: bad request: %s\n' % e).encode(),
-                              {})
-                return
-            rc, out, err, extra = self.execute(req)
-            self._respond(conn, rc, out, err, extra)
         except mod_faults.FaultInjected:
-            # injected accept/read/write fault: drop the connection —
-            # the client sees EOF/reset, exactly the failure its
-            # pre-commit retry loop exists for
-            pass
-        except OSError:
-            pass
+            return False
+        return True
+
+    def _on_overflow(self, conn):
+        """A frame grew past MAX_REQUEST_BYTES without a newline: the
+        connection cannot be resynchronized — answer with a clean v1
+        error and close (loop thread)."""
+        msg = ('dn: bad request: frame exceeds %d bytes\n'
+               % MAX_REQUEST_BYTES).encode()
+        self.loop.send(conn,
+                       mod_protocol.encode_response(1, b'', msg, {}),
+                       close_after=True)
+
+    def _on_frame(self, conn, line):
+        """One complete request line (loop thread): parse, classify
+        v1 vs v2, and hand execution to a worker thread.  Never
+        blocks — malformed frames are answered (or the connection
+        dropped) right here."""
+        rx = time.monotonic()
+        try:
+            req = json.loads(line.decode('utf-8'))
+            if not isinstance(req, dict):
+                raise ValueError('not an object')
+        except (ValueError, UnicodeDecodeError) as e:
+            err = ('dn: bad request: %s\n' % e).encode()
+            self.loop.send(
+                conn, mod_protocol.encode_response(1, b'', err, {}),
+                close_after=True, completes=True)
+            return
+        try:
+            proto, rid = mod_protocol.classify_request(req)
+        except mod_protocol.FrameError as e:
+            err = ('dn: bad request: %s\n' % e).encode()
+            self.loop.send(
+                conn, mod_protocol.encode_response(1, b'', err, {}),
+                close_after=True, completes=True)
+            return
+        if proto == mod_protocol.PROTO_V2:
+            if conn.proto is None:
+                self.loop._bump('v2_conns')
+            conn.proto = mod_protocol.PROTO_V2
+            if conn.inflight > 1:
+                self.loop._bump('frames_pipelined')
+            with conn.ids_lock:
+                duplicate = rid in conn.inflight_ids
+                if not duplicate:
+                    conn.inflight_ids.add(rid)
+            if duplicate:
+                # a client re-using an in-flight id is out of sync;
+                # answer retryably and close before responses can be
+                # misattributed
+                err = ('dn: bad request: duplicate request id %d\n'
+                       % rid).encode()
+                self.loop.send(
+                    conn, mod_protocol.encode_response(
+                        1, b'', err, {'retryable': True},
+                        proto=proto, rid=rid),
+                    close_after=True, completes=True)
+                return
+        else:
+            conn.proto = 1
+            # v1 contract: one request per connection — stop reading
+            self.loop.pause_reading(conn)
+        t = threading.Thread(target=self._handle_request,
+                             args=(conn, req, proto, rid, rx),
+                             daemon=True)
+        with self._workers_lock:
+            self._workers.add(t)
+        t.start()
+
+    # -- request handling (worker threads) --------------------------------
+
+    def _handle_request(self, conn, req, proto, rid, rx):
+        try:
+            try:
+                mod_faults.fire('serve.read')
+                # the stall seam: `delay` holds THIS request (a slow
+                # peer/stage), never the loop or other requests
+                mod_faults.fire('serve.stall')
+            except mod_faults.FaultInjected:
+                self.loop.close_conn(conn, completes=True)
+                return
+            tenant = req.get('tenant') or conn.peer or 'default'
+            deadline_ms = req.get('deadline_ms')
+            if deadline_ms is None:
+                deadline_ms = self.conf['deadline_ms']
+            deadline_at = rx + deadline_ms / 1000.0 \
+                if deadline_ms and deadline_ms > 0 else None
+            rc, out, err, extra = self.execute(
+                req, tenant=tenant, deadline_at=deadline_at)
+            self._send_response(conn, proto, rid, rc, out, err,
+                                extra)
+        except Exception as e:
+            # a request must ALWAYS resolve: respond or close, never
+            # strand the peer waiting on a frame that will not come
+            self.log.error('request handling failed', err=repr(e))
+            try:
+                msg = ('%s: internal error: %r\n'
+                       % (mod_cli.ARG0, e)).encode()
+                self._send_response(conn, proto, rid, 1, b'', msg,
+                                    {})
+            except Exception:
+                self.loop.close_conn(conn, completes=True)
         finally:
-            # deterministic teardown: close the request-side makefile
-            # FIRST (it holds a reference on the socket's fd —
-            # conn.close() alone only decrements, and a lingering fd
-            # would leave the peer blocked on a half-dead connection
-            # instead of seeing EOF), then shut the socket down hard
-            if f is not None:
-                try:
-                    f.close()
-                except OSError:
-                    pass
-            try:
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                conn.close()
-            except OSError:
-                pass
+            if rid is not None:
+                with conn.ids_lock:
+                    conn.inflight_ids.discard(rid)
             with self._workers_lock:
                 self._workers.discard(threading.current_thread())
 
-    def _respond(self, conn, rc, out, err, extra):
-        header = {'ok': rc == 0, 'rc': rc, 'nout': len(out),
-                  'nerr': len(err), 'stats': extra,
-                  'retryable': bool(extra.get('retryable'))}
-        mod_faults.fire('serve.write')
-        conn.sendall(json.dumps(header, sort_keys=True).encode() +
-                     b'\n' + out + err)
+    def _send_response(self, conn, proto, rid, rc, out, err, extra):
+        data = mod_protocol.encode_response(rc, out, err, extra,
+                                            proto=proto, rid=rid)
+        try:
+            mod_faults.fire('serve.write')
+        except mod_faults.FaultInjected:
+            # injected write fault: drop the connection — the peer
+            # sees EOF before any header (pre-commit, retry-safe)
+            self.loop.close_conn(conn, completes=True)
+            return
+        if proto == mod_protocol.PROTO_V2:
+            try:
+                mod_faults.fire('serve.frame_torn')
+            except mod_faults.FaultInjected:
+                # a torn frame: half the response then EOF — the
+                # client must classify post-commit vs pre-commit by
+                # whether ITS header arrived, never hang
+                self.loop.send(conn, data[:max(1, len(data) // 2)],
+                               close_after=True, completes=True)
+                return
+        self.loop.send(conn, data, close_after=(proto == 1),
+                       completes=True)
 
-    def execute(self, req):
+    def execute(self, req, tenant=None, deadline_at=None):
         """Execute one request dict; returns (rc, stdout_bytes,
-        stderr_bytes, header_stats)."""
+        stderr_bytes, header_stats).  `tenant` keys the fair-admission
+        queue; `deadline_at` (monotonic) is the propagated request
+        deadline load shedding enforces."""
         op = req.get('op')
         self._bump_op(op)
         if op == 'ping':
@@ -551,17 +672,20 @@ class DnServer(object):
                 counters=mod_vpipe.global_counters())
             return 0, body.encode(), b'', {}
         if op == 'build' and req.get('idempotency'):
-            return self._execute_idempotent(req['idempotency'], req)
+            return self._execute_idempotent(req['idempotency'], req,
+                                            tenant, deadline_at)
         if op in ('scan', 'query', 'build', 'query_partial') or \
                 (op == '_sleep' and
                  os.environ.get('DN_SERVE_TEST_OPS') == '1'):
-            return self._execute_data(req)
+            return self._execute_data(req, tenant=tenant,
+                                      deadline_at=deadline_at)
         self._bump('errors')
         return (1, b'',
                 ('dn: unsupported request op: "%s"\n' % op).encode(),
                 {})
 
-    def _execute_idempotent(self, key, req):
+    def _execute_idempotent(self, key, req, tenant=None,
+                            deadline_at=None):
         """Builds are NOT idempotent, so a retried build must not run
         twice: the first request with a given client-generated key is
         the leader and executes; duplicates (the client's retry after
@@ -584,7 +708,8 @@ class DnServer(object):
             rc, out, err, extra = ent['result']
             return rc, out, err, dict(extra, idempotent_replay=True)
         try:
-            result = self._execute_data(req)
+            result = self._execute_data(req, tenant=tenant,
+                                        deadline_at=deadline_at)
         except BaseException:
             # the leader died without a recordable response: retire
             # the key so a retry RE-EXECUTES (nothing committed), and
@@ -612,14 +737,15 @@ class DnServer(object):
         ent['done'].set()
         return result
 
-    def _execute_data(self, req):
+    def _execute_data(self, req, tenant=None, deadline_at=None):
         t0 = time.monotonic()
         deadline_ms = req.get('deadline_ms')
         if deadline_ms is None:
             deadline_ms = self.conf['deadline_ms']
         cap = _Capture()
         flags = {'coalesced': False, 'busy': False, 'deadline': False,
-                 'draining': False}
+                 'draining': False, 'overloaded': False,
+                 'tenant': tenant, 'deadline_at': deadline_at}
         scope_out = {}
         op = req.get('op')
 
@@ -646,8 +772,18 @@ class DnServer(object):
                 sc.obs = obs_ctx
                 try:
                     rc = self._run_data(req, flags)
+                except mod_admission.OverloadedError as e:
+                    # deadline-aware shed: retryable, with the retry
+                    # hint derived from observed service time
+                    flags['overloaded'] = True
+                    flags['retry_after_ms'] = e.retry_after_ms
+                    sys.stderr.write('%s: %s\n'
+                                     % (mod_cli.ARG0, e.message))
+                    rc = 1
                 except mod_admission.BusyError as e:
                     flags['busy'] = True
+                    flags['retry_after_ms'] = \
+                        getattr(e, 'retry_after_ms', None)
                     sys.stderr.write('%s: %s\n'
                                      % (mod_cli.ARG0, e.message))
                     rc = 1
@@ -676,6 +812,12 @@ class DnServer(object):
                         flags['missing'] = list(mp)
                     if getattr(e, 'retryable', False):
                         flags['retryable_error'] = True
+                        # degraded-because-shedding: the members'
+                        # retry hints ride up to the client
+                        if getattr(e, 'retry_after_ms', None) \
+                                is not None:
+                            flags['retry_after_ms'] = \
+                                e.retry_after_ms
                     sys.stderr.write('%s: %s\n'
                                      % (mod_cli.ARG0, e.message))
                     rc = 1
@@ -753,7 +895,21 @@ class DnServer(object):
         out, err = cap.finish()
         if rc != 0:
             self._bump('errors')
-        if flags['busy']:
+        elif op in ('scan', 'query', 'build', 'query_partial'):
+            # feed the observed-service-time estimate (retry hints +
+            # early shed) and the per-tenant fairness accounting.
+            # The sample is EXECUTION time — measured from slot
+            # acquisition, not request arrival — queue wait folded in
+            # would double-count queueing and over-shed after bursts.
+            # (Coalesced followers and routed queries never acquired
+            # a slot here: no sample, correctly.)
+            if flags.get('exec_t0') is not None:
+                self.admission.note_service_ms(
+                    (time.monotonic() - flags['exec_t0']) * 1000.0)
+            self.admission.note_completed(tenant)
+        if flags['overloaded']:
+            self._bump('shed_overloaded')
+        elif flags['busy']:
             self._bump('busy_rejected')
         if flags['deadline']:
             self._bump('deadline_expired')
@@ -764,12 +920,17 @@ class DnServer(object):
             'elapsed_ms': round((time.monotonic() - t0) * 1000, 3),
             'counters': scope_out,
         }
-        if flags['busy'] or flags['draining'] or \
-                flags.get('retryable_error'):
+        if flags['busy'] or flags['overloaded'] or \
+                flags['draining'] or flags.get('retryable_error'):
             # the request was never admitted (or failed degraded /
             # pre-execution): nothing committed, a retry is always
             # safe — the client's backoff loop keys off this
             extra['retryable'] = True
+        if flags.get('retry_after_ms') is not None:
+            # the honest retry hint: roughly when a freed slot could
+            # take this work (serve/client.py honors it in place of
+            # blind exponential backoff)
+            extra['retry_after_ms'] = flags['retry_after_ms']
         if flags.get('missing') is not None:
             # the degraded-result contract: missing partitions are
             # NAMED in the header, in both DN_ROUTER_PARTIAL modes
@@ -797,7 +958,10 @@ class DnServer(object):
         the caller to frame as 'dn: <message>'."""
         op = req['op']
         if op == '_sleep':
-            flags['slot'] = self.admission.acquire()
+            flags['slot'] = self.admission.acquire(
+                tenant=flags.get('tenant'),
+                deadline_at=flags.get('deadline_at'))
+            flags['exec_t0'] = time.monotonic()
             try:
                 time.sleep(float(req.get('ms', 0)) / 1000.0)
             finally:
@@ -836,7 +1000,10 @@ class DnServer(object):
             req, _config_ident(backend.cbl_path))
 
         def compute():
-            slot = flags['slot'] = self.admission.acquire()
+            slot = flags['slot'] = self.admission.acquire(
+                tenant=flags.get('tenant'),
+                deadline_at=flags.get('deadline_at'))
+            flags['exec_t0'] = time.monotonic()
             try:
                 with obs_trace.span('serve.execute', op=op):
                     if op == 'scan':
@@ -884,8 +1051,12 @@ class DnServer(object):
 
         def compute():
             with obs_trace.span('serve.execute', op='query.routed'):
-                return self.router.scatter(ds, dsname, query,
-                                           interval, req)
+                # deadline propagation: the remaining budget rides
+                # into every member partial (router.scatter derives
+                # per-partial deadline_ms from it)
+                return self.router.scatter(
+                    ds, dsname, query, interval, req,
+                    deadline_at=flags.get('deadline_at'))
 
         # degraded errors (RouterPartitionError) propagate as DNError
         # with their missing_partitions/retryable attrs intact — the
@@ -934,7 +1105,10 @@ class DnServer(object):
 
         def compute():
             from . import router as mod_router
-            slot = flags['slot'] = self.admission.acquire()
+            slot = flags['slot'] = self.admission.acquire(
+                tenant=flags.get('tenant'),
+                deadline_at=flags.get('deadline_at'))
+            flags['exec_t0'] = time.monotonic()
             try:
                 with self._tree_lock(ds, dsname).read(), \
                         obs_trace.span('serve.execute',
@@ -980,7 +1154,11 @@ class DnServer(object):
         opts = _opts_shim(partial_req)
         query = mod_cli.dn_query_config(opts)
         interval = partial_req.get('interval') or 'day'
-        slot = self.admission.acquire()
+        deadline_ms = partial_req.get('deadline_ms')
+        deadline_at = time.monotonic() + deadline_ms / 1000.0 \
+            if deadline_ms and deadline_ms > 0 else None
+        slot = self.admission.acquire(
+            tenant=partial_req.get('tenant'), deadline_at=deadline_at)
         try:
             with self._tree_lock(ds, dsname).read():
                 return mod_router.partial_query(
@@ -1005,7 +1183,10 @@ class DnServer(object):
         if len(metrics) == 0:
             mod_cli.fatal(DNError('no metrics defined for dataset '
                                   '"%s"' % dsname))
-        slot = flags['slot'] = self.admission.acquire()
+        slot = flags['slot'] = self.admission.acquire(
+            tenant=flags.get('tenant'),
+            deadline_at=flags.get('deadline_at'))
+        flags['exec_t0'] = time.monotonic()
         try:
             with self._tree_lock(ds, dsname).write(), \
                     obs_trace.span('serve.execute', op='build'):

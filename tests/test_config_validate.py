@@ -141,17 +141,58 @@ def test_metric_entry_shape():
 def test_serve_config_defaults():
     conf = mod_config.serve_config(env={})
     assert conf == {'max_inflight': 4, 'queue_depth': 16,
-                    'deadline_ms': 0, 'coalesce': True, 'drain_s': 30}
+                    'deadline_ms': 0, 'coalesce': True, 'drain_s': 30,
+                    'read_deadline_ms': 10000,
+                    'write_deadline_ms': 60000, 'idle_ms': 300000,
+                    'tenant_quota': 0, 'tenant_default_weight': 1,
+                    'tenant_weights': {}}
 
 
 def test_serve_config_parses_overrides():
     conf = mod_config.serve_config(env={
         'DN_SERVE_MAX_INFLIGHT': '2', 'DN_SERVE_QUEUE_DEPTH': '0',
         'DN_SERVE_DEADLINE_MS': '1500', 'DN_SERVE_COALESCE': '0',
-        'DN_SERVE_DRAIN_S': '5'})
+        'DN_SERVE_DRAIN_S': '5', 'DN_SERVE_READ_DEADLINE_MS': '250',
+        'DN_SERVE_WRITE_DEADLINE_MS': '0', 'DN_SERVE_IDLE_MS': '900',
+        'DN_SERVE_TENANT_QUOTA': '3',
+        'DN_SERVE_TENANT_DEFAULT_WEIGHT': '2',
+        'DN_SERVE_TENANT_WEIGHTS': 'alice:3, bob:1'})
     assert conf == {'max_inflight': 2, 'queue_depth': 0,
                     'deadline_ms': 1500, 'coalesce': False,
-                    'drain_s': 5}
+                    'drain_s': 5, 'read_deadline_ms': 250,
+                    'write_deadline_ms': 0, 'idle_ms': 900,
+                    'tenant_quota': 3, 'tenant_default_weight': 2,
+                    'tenant_weights': {'alice': 3, 'bob': 1}}
+
+
+def test_serve_config_rejects_bad_tenant_knobs():
+    err = mod_config.serve_config(
+        env={'DN_SERVE_TENANT_WEIGHTS': 'alice'})
+    assert isinstance(err, DNError)
+    assert 'DN_SERVE_TENANT_WEIGHTS' in str(err)
+    err = mod_config.serve_config(
+        env={'DN_SERVE_TENANT_WEIGHTS': 'alice:0'})
+    assert isinstance(err, DNError)
+    assert 'weight for "alice"' in str(err)
+    err = mod_config.serve_config(
+        env={'DN_SERVE_TENANT_DEFAULT_WEIGHT': '0'})
+    assert isinstance(err, DNError)
+    err = mod_config.serve_config(
+        env={'DN_SERVE_READ_DEADLINE_MS': '-1'})
+    assert isinstance(err, DNError)
+    assert str(err) == ('DN_SERVE_READ_DEADLINE_MS: expected an '
+                        'integer >= 0, got "-1"')
+
+
+def test_remote_config_deadline_knob():
+    conf = mod_config.remote_config(env={})
+    assert conf['deadline_ms'] == 0
+    conf = mod_config.remote_config(
+        env={'DN_REMOTE_DEADLINE_MS': '2500'})
+    assert conf['deadline_ms'] == 2500
+    err = mod_config.remote_config(
+        env={'DN_REMOTE_DEADLINE_MS': 'soon'})
+    assert isinstance(err, DNError)
 
 
 def test_serve_config_rejects_bad_values():

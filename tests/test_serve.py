@@ -804,8 +804,12 @@ def test_serve_validate_ok(monkeypatch):
     assert rc == 0
     assert out == (b'serve config ok: max_inflight=3 queue_depth=16 '
                    b'deadline_ms=2500 coalesce=1 drain_s=30\n'
+                   b'serve front-end ok: read_deadline_ms=10000 '
+                   b'write_deadline_ms=60000 idle_ms=300000\n'
+                   b'serve tenancy ok: quota=0 default_weight=1 '
+                   b'weights=none\n'
                    b'remote config ok: retries=2 backoff_ms=50 '
-                   b'connect_timeout_s=5\n'
+                   b'connect_timeout_s=5 deadline_ms=0\n'
                    b'obs config ok: trace=off slow_ms=off '
                    b'buckets=14\n'
                    b'router config ok: probe_ms=500 failures=3 '

@@ -1,0 +1,773 @@
+"""Protocol v2, the selector front end, and overload-first admission
+(dragnet_tpu/serve/{protocol,ioloop,pool,admission}.py).
+
+Covers: v2 pipelining with out-of-order responses, v1<->v2
+negotiation (v2 server serving v1 clients byte-identically, v2
+clients downgrading against v1 servers), the frame fuzz matrix
+(garbage/torn/oversized frames, duplicate request ids — every case a
+clean retryable DNError or connection close, never a hang or short
+bytes), the slow-loris read-deadline reap, the idle reaper,
+per-tenant quotas and weighted-fair scheduling, deadline-aware load
+shedding with retry_after_ms, the client honoring retry_after_ms,
+shed-vs-breaker interaction (shed != down), and pooled-connection
+reuse."""
+
+import json
+import os
+import socket as mod_socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import cli                                # noqa: E402
+from dragnet_tpu.errors import DNError                     # noqa: E402
+from dragnet_tpu.serve import admission as mod_admission   # noqa: E402
+from dragnet_tpu.serve import client as mod_client         # noqa: E402
+from dragnet_tpu.serve import pool as mod_pool             # noqa: E402
+from dragnet_tpu.serve import protocol as mod_protocol     # noqa: E402
+from dragnet_tpu.serve import router as mod_router         # noqa: E402
+from dragnet_tpu.serve import server as mod_server         # noqa: E402
+
+from test_serve import run_cli, _gen_corpus                # noqa: E402
+
+
+@pytest.fixture(scope='module')
+def corpus(tmp_path_factory):
+    root = tmp_path_factory.mktemp('proto_corpus')
+    datafile = str(root / 'data.log')
+    _gen_corpus(datafile)
+    rc_path = str(root / 'dragnetrc.json')
+    prior = os.environ.get('DRAGNET_CONFIG')
+    os.environ['DRAGNET_CONFIG'] = rc_path
+    try:
+        idx = str(root / 'idx')
+        rc, out, err = run_cli([
+            'datasource-add', '--path', datafile, '--index-path',
+            idx, '--time-field', 'time', 'ds_p'])
+        assert rc == 0, err
+        rc, out, err = run_cli([
+            'metric-add', '-b', 'host,latency[aggr=quantize]',
+            'ds_p', 'm1'])
+        assert rc == 0, err
+        rc, out, err = run_cli(['build', 'ds_p'])
+        assert rc == 0, err
+        yield {'root': root, 'rc_path': rc_path, 'ds': 'ds_p'}
+    finally:
+        if prior is None:
+            os.environ.pop('DRAGNET_CONFIG', None)
+        else:
+            os.environ['DRAGNET_CONFIG'] = prior
+
+
+def _conf(**over):
+    base = {'max_inflight': 4, 'queue_depth': 16, 'deadline_ms': 0,
+            'coalesce': True, 'drain_s': 10}
+    base.update(over)
+    return base
+
+
+def _query_req(corpus):
+    return {'op': 'query', 'ds': corpus['ds'],
+            'config': corpus['rc_path'], 'interval': 'day',
+            'queryconfig': {'breakdowns': [
+                {'name': 'host', 'field': 'host'}]},
+            'opts': {}}
+
+
+@pytest.fixture
+def server(corpus, tmp_path):
+    sock = str(tmp_path / 'dn.sock')
+    srv = mod_server.DnServer(socket_path=sock, conf=_conf()).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+# -- raw-socket helpers ------------------------------------------------------
+
+def _dial(path, timeout=10.0):
+    s = mod_socket.socket(mod_socket.AF_UNIX, mod_socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    s.connect(path)
+    return s
+
+
+def _read_frame(f):
+    """One response frame from a socket makefile: (header, payload)
+    or (None, b'') on EOF."""
+    line = f.readline(mod_protocol.MAX_FRAME_BYTES)
+    if not line:
+        return None, b''
+    header = json.loads(line.decode('utf-8'))
+    need = int(header.get('nout', 0)) + int(header.get('nerr', 0))
+    payload = b''
+    while len(payload) < need:
+        chunk = f.read(need - len(payload))
+        if not chunk:
+            break
+        payload += chunk
+    return header, payload
+
+
+# -- v2: pipelining, out-of-order, multiplexed byte identity ----------------
+
+def test_v2_pipelined_out_of_order(server, monkeypatch):
+    """Three pipelined v2 requests with inverted service times: the
+    responses come back tagged by id in completion (not submission)
+    order, on ONE connection."""
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    s = _dial(server.socket_path)
+    try:
+        f = s.makefile('rb')
+        for rid, ms in ((1, 400), (2, 120), (3, 5)):
+            s.sendall(mod_protocol.encode_request(
+                {'op': '_sleep', 'ms': ms}, rid))
+        order = []
+        for _ in range(3):
+            header, _payload = _read_frame(f)
+            assert header is not None
+            assert header.get('proto') == 2
+            order.append(header['id'])
+            assert header['rc'] == 0
+        assert sorted(order) == [1, 2, 3]
+        assert order[0] == 3, order    # fastest answered first
+        assert order[-1] == 1, order
+    finally:
+        s.close()
+
+
+def test_v2_multiplexed_byte_identical_to_v1(server, corpus):
+    """The same query through the raw v1 single-shot path and the
+    pooled v2 multiplexed path: identical rc/stdout/stderr bytes."""
+    req = _query_req(corpus)
+    v1 = mod_client.request_bytes(server.socket_path, dict(req),
+                                  pooled=False)
+    v2 = mod_client.request_bytes(server.socket_path, dict(req),
+                                  pooled=True)
+    assert v1[0] == v2[0] == 0
+    assert v1[2] == v2[2] and v1[3] == v2[3]
+    st = mod_client.stats(server.socket_path)
+    assert st['protocol']['v2_conns'] >= 1
+
+
+def test_v2_remote_cli_byte_identical(server, corpus):
+    """`--remote` (now pooled v2) byte-identical to the local CLI for
+    query/scan/build — the PR 5 contract preserved across the
+    protocol change."""
+    for case in (['query', '-b', 'host', corpus['ds']],
+                 ['scan', '-b', 'host', '--raw', corpus['ds']],
+                 ['build', corpus['ds']]):
+        expected = run_cli(case)
+        got = run_cli(case[:1] + ['--remote', server.socket_path] +
+                      case[1:])
+        assert got == expected, case
+
+
+def test_v1_client_still_served_and_closed(server, corpus):
+    """A legacy v1 request (no proto field): correct response header
+    WITHOUT an id, then the server closes the connection — the PR 5
+    one-request-per-connection contract, byte-identical."""
+    s = _dial(server.socket_path)
+    try:
+        f = s.makefile('rb')
+        s.sendall(json.dumps(_query_req(corpus)).encode() + b'\n')
+        header, payload = _read_frame(f)
+        assert header is not None and header['rc'] == 0
+        assert 'id' not in header and 'proto' not in header
+        assert len(payload) == header['nout'] + header['nerr']
+        assert f.read(1) == b''          # server closed after one
+    finally:
+        s.close()
+
+
+def test_negotiation_downgrades_against_v1_server(tmp_path):
+    """A v2 pooled client against a v1 server (simulated: responds
+    without an id and closes): the response is KEPT, the endpoint is
+    downgraded, and the next request rides the dial-per-request
+    path."""
+    sock = str(tmp_path / 'v1.sock')
+    listener = mod_socket.socket(mod_socket.AF_UNIX,
+                                 mod_socket.SOCK_STREAM)
+    listener.bind(sock)
+    listener.listen(8)
+    served = []
+    stop = threading.Event()
+
+    def v1_server():
+        listener.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except mod_socket.timeout:
+                continue
+            except OSError:
+                return
+            f = conn.makefile('rb')
+            line = f.readline()
+            if line:
+                served.append(json.loads(line.decode()))
+                out = b'pong\n'
+                hdr = {'ok': True, 'rc': 0, 'nout': len(out),
+                       'nerr': 0, 'stats': {}, 'retryable': False}
+                conn.sendall(json.dumps(hdr).encode() + b'\n' + out)
+            f.close()
+            conn.close()
+
+    t = threading.Thread(target=v1_server, daemon=True)
+    t.start()
+    try:
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, {'op': 'ping'}, pooled=True)
+        assert rc == 0 and out == b'pong\n'
+        assert mod_pool.get().is_v1(sock)
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, {'op': 'ping'}, pooled=True)    # dial path now
+        assert rc == 0 and out == b'pong\n'
+        # the v1 server saw v2-framed then plain requests, all valid
+        assert served[0].get('proto') == 2
+    finally:
+        stop.set()
+        t.join(3)
+        listener.close()
+
+
+# -- frame fuzz: torn / garbage / oversized / duplicate ids -----------------
+
+def test_garbage_frame_clean_error(server):
+    s = _dial(server.socket_path)
+    try:
+        f = s.makefile('rb')
+        s.sendall(b'{not json at all\n')
+        header, payload = _read_frame(f)
+        assert header is not None and header['rc'] == 1
+        assert b'bad request' in payload
+        assert f.read(1) == b''
+    finally:
+        s.close()
+
+
+def test_torn_frame_then_eof_survived(server, corpus):
+    """Half a request then EOF: the server drops the connection and
+    keeps serving others — no hang, no traceback."""
+    s = _dial(server.socket_path)
+    s.sendall(b'{"op": "que')            # torn mid-frame
+    s.close()
+    rc, hd, out, err = mod_client.request_bytes(
+        server.socket_path, _query_req(corpus))
+    assert rc == 0
+
+
+def test_bad_proto_and_bad_id_clean_errors(server):
+    for frame in (b'{"op": "ping", "proto": 3, "id": 1}\n',
+                  b'{"op": "ping", "proto": 2}\n',
+                  b'{"op": "ping", "proto": 2, "id": -4}\n',
+                  b'{"op": "ping", "proto": 2, "id": "x"}\n',
+                  b'[1, 2, 3]\n'):
+        s = _dial(server.socket_path)
+        try:
+            f = s.makefile('rb')
+            s.sendall(frame)
+            header, payload = _read_frame(f)
+            assert header is not None and header['rc'] == 1, frame
+            assert b'bad request' in payload, frame
+            assert f.read(1) == b''
+        finally:
+            s.close()
+
+
+def test_duplicate_request_id_rejected(server, monkeypatch):
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    s = _dial(server.socket_path)
+    try:
+        f = s.makefile('rb')
+        s.sendall(mod_protocol.encode_request(
+            {'op': '_sleep', 'ms': 400}, 7))
+        s.sendall(mod_protocol.encode_request(
+            {'op': '_sleep', 'ms': 1}, 7))     # same id, in flight
+        header, payload = _read_frame(f)
+        assert header is not None
+        assert header['id'] == 7 and header['rc'] == 1
+        assert b'duplicate request id' in payload
+        assert header.get('retryable') is True
+    finally:
+        s.close()
+
+
+def test_oversized_frame_clean_close(corpus, tmp_path):
+    """A frame past MAX_FRAME_BYTES without a newline: a clean error
+    response (or EOF) and a closed connection — never a hang."""
+    sock = str(tmp_path / 'big.sock')
+    srv = mod_server.DnServer(socket_path=sock, conf=_conf()).start()
+    try:
+        s = _dial(sock, timeout=60.0)
+        try:
+            blob = b'a' * (mod_protocol.MAX_FRAME_BYTES + 2)
+            try:
+                s.sendall(blob)
+            except OSError:
+                pass                     # server may cut us off early
+            f = s.makefile('rb')
+            header, payload = _read_frame(f)
+            if header is not None:       # error frame before close
+                assert header['rc'] == 1
+                assert b'frame exceeds' in payload
+            assert f.read(1) == b''
+        finally:
+            s.close()
+        # the server is still healthy
+        doc = mod_client.health(sock)
+        assert doc['ok'] is True
+    finally:
+        srv.stop()
+
+
+# -- reaping: slow-loris read deadline + idle --------------------------------
+
+def test_half_written_request_reaped_while_concurrent_completes(
+        corpus, tmp_path):
+    """The server.py:463 regression (PR 5's blocking makefile read):
+    a peer that sends half a header is reaped by the read deadline
+    while a concurrent request completes normally."""
+    sock = str(tmp_path / 'loris.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(read_deadline_ms=300, idle_ms=0)).start()
+    try:
+        loris = _dial(sock)
+        loris.sendall(b'{"op": "quer')   # half a request, no newline
+        # a concurrent full request completes while the loris hangs
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, _query_req(corpus))
+        assert rc == 0
+        # the loris connection is reaped within the read deadline
+        loris.settimeout(5.0)
+        assert loris.recv(1) == b''
+        loris.close()
+        st = mod_client.stats(sock)
+        assert st['protocol']['reaped_read_deadline'] >= 1
+    finally:
+        srv.stop()
+
+
+def test_drip_feed_slow_loris_still_reaped(corpus, tmp_path):
+    """The deadline clock starts at the partial frame's FIRST byte:
+    a peer dripping one byte per interval must NOT keep resetting it
+    (each drip refreshes activity, but never the frame deadline)."""
+    sock = str(tmp_path / 'drip.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(read_deadline_ms=400, idle_ms=0)).start()
+    try:
+        drip = _dial(sock)
+        drip.settimeout(10.0)
+        reaped = False
+        t0 = time.monotonic()
+        try:
+            for _ in range(20):          # one byte every 100ms
+                drip.sendall(b'x')
+                time.sleep(0.1)
+        except OSError:
+            reaped = True
+        if not reaped:
+            # the send side may not error promptly; EOF proves it
+            reaped = drip.recv(1) == b''
+        assert reaped
+        assert time.monotonic() - t0 < 8.0
+        drip.close()
+        st = mod_client.stats(sock)
+        assert st['protocol']['reaped_read_deadline'] >= 1
+    finally:
+        srv.stop()
+
+
+def test_idle_connection_reaped(corpus, tmp_path):
+    sock = str(tmp_path / 'idle.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock, conf=_conf(idle_ms=200)).start()
+    try:
+        s = _dial(sock)
+        s.settimeout(5.0)
+        assert s.recv(1) == b''          # reaped while idle
+        s.close()
+        st = mod_client.stats(sock)
+        assert st['protocol']['reaped_idle'] >= 1
+    finally:
+        srv.stop()
+
+
+# -- per-tenant admission: quota + weighted fairness ------------------------
+
+def test_tenant_quota_rejects_flood_not_others():
+    adm = mod_admission.Admission(1, 100, tenant_quota=2)
+    held = adm.acquire(tenant='a')
+    queued = []
+
+    def queue_one(tenant):
+        slot = adm.acquire(tenant=tenant)
+        queued.append(tenant)
+        slot.release()
+
+    threads = [threading.Thread(target=queue_one, args=('a',))
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5
+    while adm.depth()['queued'] < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # tenant a's quota is saturated: its next request is rejected
+    # with the tenant-scoped busy error + retry hint...
+    with pytest.raises(mod_admission.BusyError) as ei:
+        adm.acquire(tenant='a')
+    assert 'tenant "a"' in ei.value.message
+    assert ei.value.retry_after_ms is not None
+    # ...while tenant b still queues fine
+    tb = threading.Thread(target=queue_one, args=('b',))
+    tb.start()
+    while adm.depth()['queued'] < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    held.release()
+    for t in threads:
+        t.join(5)
+    tb.join(5)
+    assert sorted(queued) == ['a', 'a', 'b']
+
+
+def test_weighted_fair_dequeue_order():
+    """Weight 3:1 under contention: the stride scheduler grants
+    tenant a roughly 3x as often as tenant b."""
+    adm = mod_admission.Admission(
+        1, 100, tenant_weights={'a': 3, 'b': 1})
+    held = adm.acquire(tenant='warm')
+    grants = []
+    glock = threading.Lock()
+
+    def worker(tenant):
+        slot = adm.acquire(tenant=tenant)
+        with glock:
+            grants.append(tenant)
+        slot.release()                   # cascade the next grant
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in ('a',) * 6 + ('b',) * 6]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5
+    while adm.depth()['queued'] < 12 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert adm.depth()['queued'] == 12
+    held.release()
+    for t in threads:
+        t.join(5)
+    assert len(grants) == 12
+    # first 8 grants: a should take ~6 of them (3:1 weights)
+    early_a = grants[:8].count('a')
+    assert early_a >= 5, grants
+    doc = adm.tenants_doc()
+    assert doc['tenants']['a']['weight'] == 3
+    assert doc['tenants']['a']['admitted'] == 6
+
+
+# -- load shedding + retry_after_ms -----------------------------------------
+
+def test_overload_shed_early_with_retry_after(corpus, tmp_path,
+                                              monkeypatch):
+    """A queued request whose remaining deadline is below the
+    observed service time is shed EARLY: clean retryable error with
+    retry_after_ms, fast, and it never occupies a slot."""
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'shed.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=8)).start()
+    try:
+        srv.admission.note_service_ms(5000.0)    # observed: slow
+        holder = threading.Thread(
+            target=mod_client.request_bytes,
+            args=(sock, {'op': '_sleep', 'ms': 600}))
+        holder.start()
+        time.sleep(0.2)
+        t0 = time.monotonic()
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, dict(_query_req(corpus), deadline_ms=250))
+        dt = time.monotonic() - t0
+        holder.join()
+        assert rc == 1
+        assert b'overloaded' in err and b'shed' in err
+        assert hd['retryable'] is True
+        assert isinstance(hd.get('retry_after_ms'), int)
+        assert hd['retry_after_ms'] > 0
+        assert dt < 0.5                  # shed fast, no slot wait
+        st = mod_client.stats(sock)
+        assert st['requests']['shed_overloaded'] == 1
+        assert st['tenants']['shed_overload'] >= 1
+        # the server is unharmed: a fresh request succeeds
+        rc2, _, _, _ = mod_client.request_bytes(sock,
+                                                _query_req(corpus))
+        assert rc2 == 0
+    finally:
+        srv.stop()
+
+
+def test_busy_rejection_carries_retry_after(corpus, tmp_path,
+                                            monkeypatch):
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'busy.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=0)).start()
+    try:
+        holder = threading.Thread(
+            target=mod_client.request_bytes,
+            args=(sock, {'op': '_sleep', 'ms': 500}))
+        holder.start()
+        time.sleep(0.15)
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, _query_req(corpus))
+        holder.join()
+        assert rc == 1
+        assert hd['retryable'] is True
+        assert isinstance(hd.get('retry_after_ms'), int)
+        assert (hd.get('stats') or {}).get('retry_after_ms') == \
+            hd['retry_after_ms']
+    finally:
+        srv.stop()
+
+
+def test_client_honors_retry_after_hint(corpus, tmp_path,
+                                        monkeypatch):
+    """The retry loop sleeps the server's retry_after_ms hint (with
+    jitter) instead of the blind exponential backoff."""
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    monkeypatch.setenv('DN_REMOTE_RETRIES', '6')
+    monkeypatch.setenv('DN_REMOTE_BACKOFF_MS', '1000')
+    sock = str(tmp_path / 'hint.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=0)).start()
+    slept = []
+    real_sleep = time.sleep
+
+    def spy_sleep(s):
+        slept.append(s)
+        real_sleep(min(s, 0.1))
+
+    try:
+        srv.admission.note_service_ms(80.0)   # retry hints ~80-160ms
+        holder = threading.Thread(
+            target=mod_client.request_bytes,
+            args=(sock, {'op': '_sleep', 'ms': 500}))
+        holder.start()
+        real_sleep(0.15)
+        monkeypatch.setattr(mod_client.time, 'sleep', spy_sleep)
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, _query_req(corpus), retry=True)
+        monkeypatch.setattr(mod_client.time, 'sleep', real_sleep)
+        holder.join()
+        assert rc == 0                   # recovered once slot freed
+        assert slept, 'no retry sleep recorded'
+        # every recorded backoff follows the ~80-160ms hint, not the
+        # 1000ms exponential floor the env would impose
+        assert all(s < 0.5 for s in slept), slept
+    finally:
+        srv.stop()
+
+
+# -- shed != down: breaker interaction --------------------------------------
+
+def test_shed_burst_does_not_trip_breaker(tmp_path):
+    """A member answering retryable rejections (shed/busy) is ALIVE:
+    the router's breaker must record success, not failure — a shed
+    burst must never escalate into a (fake) outage.  Non-retryable
+    failures still open it."""
+    sock = str(tmp_path / 'm.sock')
+    listener = mod_socket.socket(mod_socket.AF_UNIX,
+                                 mod_socket.SOCK_STREAM)
+    listener.bind(sock)
+    listener.listen(8)
+    mode = {'retryable': True}
+    stop = threading.Event()
+
+    def member():
+        listener.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except mod_socket.timeout:
+                continue
+            except OSError:
+                return
+            f = conn.makefile('rb')
+            if f.readline():
+                err = b'dn: server busy: shed\n'
+                hdr = {'ok': False, 'rc': 1, 'nout': 0,
+                       'nerr': len(err), 'stats': {},
+                       'retryable': mode['retryable'],
+                       'retry_after_ms': 40}
+                conn.sendall(json.dumps(hdr).encode() + b'\n' + err)
+            f.close()
+            conn.close()
+
+    t = threading.Thread(target=member, daemon=True)
+    t.start()
+    try:
+        breaker = mod_router.Breaker(failures=2, cooldown_ms=60000)
+        st = mod_router.MemberState('m', sock, breaker)
+        router = object.__new__(mod_router.Router)
+        router.member = 'r'
+        router.states = {'m': st}
+        router.conf = {'fetch_timeout_s': 10}
+        router._lock = threading.Lock()
+        router._counters = {}
+        router._latency = __import__(
+            'dragnet_tpu.obs.metrics',
+            fromlist=['Histogram']).Histogram()
+        router._latency_lock = threading.Lock()
+        preq = {'op': 'query_partial', 'partitions': [0]}
+        for _ in range(5):               # a shed burst
+            with pytest.raises(DNError):
+                router._fetch_one('m', 0, preq, timeout_s=10)
+        snap = breaker.snapshot()
+        assert snap['state'] == 'closed'
+        assert snap['consecutive_failures'] == 0
+        # flip the member to NON-retryable failures: breaker food
+        mode['retryable'] = False
+        for _ in range(2):
+            with pytest.raises(DNError):
+                router._fetch_one('m', 0, preq, timeout_s=10)
+        assert breaker.snapshot()['state'] == 'open'
+    finally:
+        stop.set()
+        t.join(3)
+        listener.close()
+
+
+# -- deadline propagation through the router --------------------------------
+
+def test_router_propagates_remaining_deadline(corpus):
+    """scatter() derives each partial's deadline_ms from the routed
+    request's remaining budget, and forwards the tenant identity."""
+    from dragnet_tpu.serve import topology as mod_topology
+    topo_doc = {
+        'epoch': 1, 'assign': 'hash',
+        'members': {'a': {'endpoint': '/nonexistent.sock'}},
+        'partitions': [{'id': 0, 'replicas': ['a']}],
+    }
+    topo = mod_topology.Topology(topo_doc)
+    captured = {}
+
+    def local_exec(pids, preq):
+        captured.update(preq)
+        return []
+
+    router = mod_router.Router(
+        topo, 'a',
+        conf={'probe_ms': 10000, 'failures': 3, 'cooldown_ms': 1000,
+              'hedge_ms': 0, 'fetch_timeout_s': 30,
+              'partial': 'allow'},
+        local_exec=local_exec)
+    opts = mod_server._opts_shim(_query_req(corpus))
+    query = cli.dn_query_config(opts)
+    req = dict(_query_req(corpus), tenant='dash-7')
+    result, missing = router.scatter(
+        None, corpus['ds'], query, 'day', req,
+        deadline_at=time.monotonic() + 2.0)
+    assert missing == []
+    assert captured.get('tenant') == 'dash-7'
+    assert 0 < captured.get('deadline_ms') <= 2000
+
+
+# -- pooled connections ------------------------------------------------------
+
+def test_pool_reuses_one_connection(server, corpus):
+    """N pooled requests ride ONE accepted connection; the raw
+    single-shot path dials per request."""
+    before = mod_client.stats(server.socket_path)['protocol']
+    req = _query_req(corpus)
+    for _ in range(6):
+        rc, _, _, _ = mod_client.request_bytes(
+            server.socket_path, dict(req), pooled=True)
+        assert rc == 0
+    after = mod_client.stats(server.socket_path)['protocol']
+    # stats probes themselves are pooled: the whole burst costs at
+    # most a couple of accepts, not one per request
+    assert after['conns_accepted'] - before['conns_accepted'] <= 2
+    assert mod_pool.get().stats()['reuses'] >= 5
+
+
+def test_tenant_identity_rides_env(server, corpus, monkeypatch):
+    monkeypatch.setenv('DN_REMOTE_TENANT', 'team-red')
+    rc, hd, out, err = mod_client.request_bytes(
+        server.socket_path, _query_req(corpus), pooled=True)
+    assert rc == 0
+    st = mod_client.stats(server.socket_path)
+    assert 'team-red' in st['tenants']['tenants']
+
+
+# -- new fault seams ---------------------------------------------------------
+
+def test_frame_torn_fault_clean_client_error(server, corpus,
+                                             monkeypatch):
+    """serve.frame_torn armed at rate 1.0: every v2 response is cut
+    mid-frame — the client resolves with a clean retryable DNError
+    (or transport error), never a hang or short bytes."""
+    from dragnet_tpu import faults as mod_faults
+    monkeypatch.setenv('DN_FAULTS', 'serve.frame_torn:error:1.0')
+    monkeypatch.setenv('DN_REMOTE_RETRIES', '1')
+    monkeypatch.setenv('DN_REMOTE_BACKOFF_MS', '5')
+    mod_faults.reset()
+    try:
+        with pytest.raises(DNError):
+            mod_client.request_bytes(server.socket_path,
+                                     _query_req(corpus),
+                                     retry=True, pooled=True)
+    finally:
+        monkeypatch.delenv('DN_FAULTS')
+        mod_faults.reset()
+
+
+def test_stall_fault_delays_but_completes(server, corpus,
+                                          monkeypatch):
+    from dragnet_tpu import faults as mod_faults
+    monkeypatch.setenv('DN_FAULTS', 'serve.stall:delay:1.0')
+    mod_faults.reset()
+    try:
+        rc, hd, out, err = mod_client.request_bytes(
+            server.socket_path, _query_req(corpus))
+        assert rc == 0
+    finally:
+        monkeypatch.delenv('DN_FAULTS')
+        mod_faults.reset()
+
+
+def test_tenant_flood_fault_clean_busy(corpus, tmp_path,
+                                       monkeypatch):
+    from dragnet_tpu import faults as mod_faults
+    monkeypatch.setenv('DN_SERVE_TEST_OPS', '1')
+    sock = str(tmp_path / 'flood.sock')
+    srv = mod_server.DnServer(
+        socket_path=sock,
+        conf=_conf(max_inflight=1, queue_depth=8)).start()
+    try:
+        holder = threading.Thread(
+            target=mod_client.request_bytes,
+            args=(sock, {'op': '_sleep', 'ms': 500}))
+        holder.start()
+        time.sleep(0.15)
+        monkeypatch.setenv('DN_FAULTS', 'tenant.flood:error:1.0')
+        mod_faults.reset()
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, _query_req(corpus))
+        monkeypatch.delenv('DN_FAULTS')
+        mod_faults.reset()
+        holder.join()
+        assert rc == 1
+        assert hd['retryable'] is True
+        assert b'server busy' in err
+    finally:
+        srv.stop()

@@ -50,6 +50,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 from dragnet_tpu import cli                                # noqa: E402
+from dragnet_tpu.errors import DNError                     # noqa: E402
 from dragnet_tpu import faults as mod_faults               # noqa: E402
 from dragnet_tpu import index_journal as mod_journal       # noqa: E402
 from dragnet_tpu import vpipe as mod_vpipe                 # noqa: E402
@@ -758,6 +759,278 @@ def soak_cluster(root, fast=False, verbose=True, floor=None):
     return s.summary()
 
 
+# -- overload drill (multi-tenant flood at ~5x capacity) --------------------
+
+# faults armed during the flood: torn v2 response frames, per-request
+# stalls, and injected tenant-flood rejections — the protocol/overload
+# seams this drill exists to prove out (plus a little transport chaos)
+OVERLOAD_SPEC = ('serve.frame_torn:error:0.02:71,'
+                 'serve.stall:delay:0.12:72,'
+                 'tenant.flood:error:0.03:73')
+
+
+class OverloadSoak(ClusterSoak):
+    """Multi-tenant flood at ~5x capacity against the 3-member
+    cluster (member b the SIGKILL-able subprocess), tenant weights
+    alpha:3 beta:1, torn-frame/stall/flood faults armed, one SIGKILL
+    mid-flood.  The contract: every request RESOLVES inside
+    deadline + grace (no hangs), accepted responses are
+    byte-identical to the fault-free golden, rejections are clean
+    retryable errors (busy/overloaded ones carrying retry_after_ms),
+    and per-tenant completion ratios land within 2x of the
+    configured weights."""
+
+    TENANT_WEIGHTS = {'alpha': 3, 'beta': 1}
+    MAX_INFLIGHT = 2
+    OP_GRACE_S = 30.0       # per-op resolve bound (deadline + grace)
+
+    def start_cluster(self):
+        root = self.ctx['root']
+        self.socks = {m: os.path.join(root, 'dn-%s.sock' % m)
+                      for m in 'abc'}
+        self.topo_path = os.path.join(root, 'topo.json')
+        with open(self.topo_path, 'w') as f:
+            json.dump({
+                'epoch': 1, 'assign': 'hash',
+                'members': {m: {'endpoint': self.socks[m]}
+                            for m in 'abc'},
+                'partitions': [
+                    {'id': 0, 'replicas': ['a', 'b']},
+                    {'id': 1, 'replicas': ['b', 'c']},
+                    {'id': 2, 'replicas': ['c', 'a']},
+                ],
+            }, f)
+        from dragnet_tpu.serve import topology as mod_topology
+        weights_spec = ','.join(
+            '%s:%d' % (n, w)
+            for n, w in sorted(self.TENANT_WEIGHTS.items()))
+        # capacity is deliberately TINY (the flood must be ~5x it);
+        # coalescing is off so identical flood queries cannot share
+        # one execution and fake infinite capacity
+        conf = {'max_inflight': self.MAX_INFLIGHT, 'queue_depth': 10,
+                'deadline_ms': 0, 'coalesce': False, 'drain_s': 10,
+                'tenant_quota': 4,
+                'tenant_weights': dict(self.TENANT_WEIGHTS)}
+        # member b (subprocess) reads the same knobs from env
+        os.environ.update({
+            'DN_SERVE_MAX_INFLIGHT': str(self.MAX_INFLIGHT),
+            'DN_SERVE_QUEUE_DEPTH': '10',
+            'DN_SERVE_COALESCE': '0',
+            'DN_SERVE_TENANT_QUOTA': '4',
+            'DN_SERVE_TENANT_WEIGHTS': weights_spec})
+        for m in 'ac':
+            topo = mod_topology.load_topology(self.topo_path,
+                                              member=m)
+            self.servers[m] = mod_server.DnServer(
+                socket_path=self.socks[m], conf=dict(conf),
+                cluster=topo, member=m).start()
+        self.spawn_b()
+
+    # -- the flood ----------------------------------------------------
+
+    def flood_docs(self, fmt):
+        """Request documents paired with the CLI case whose golden
+        bytes an accepted response must match."""
+        ds = self.ctx['ds'][fmt]
+        return [
+            (tuple(['query', '-b', 'host', ds]),
+             {'op': 'query', 'ds': ds,
+              'config': self.ctx['rc_path'], 'interval': 'day',
+              'queryconfig': {'breakdowns': [
+                  {'name': 'host', 'field': 'host'}]},
+              'opts': {}}),
+            (tuple(['query', '-b', 'host,latency[aggr=quantize]',
+                    '--raw', ds]),
+             {'op': 'query', 'ds': ds,
+              'config': self.ctx['rc_path'], 'interval': 'day',
+              'queryconfig': {'breakdowns': [
+                  {'name': 'host', 'field': 'host'},
+                  {'name': 'latency', 'field': 'latency',
+                   'aggr': 'quantize'}]},
+              'opts': {'raw': True}}),
+        ]
+
+    def verify_doc_equivalence(self, fmt):
+        """Prove (fault-free) that each flood document's routed bytes
+        equal the golden CLI bytes — the flood's byte checks then
+        compare against the same goldens."""
+        for case, doc in self.flood_docs(fmt):
+            rc, hd, out, err = mod_client.request_bytes(
+                self.socks['a'], dict(doc), timeout_s=60.0,
+                pooled=True)
+            self.ops += 1
+            gold = self.golden[(fmt, case)]
+            if rc != 0 or out != gold[1]:
+                self.violate('flood doc %s: fault-free routed bytes '
+                             'diverge from golden (rc=%d)'
+                             % (' '.join(case), rc))
+
+    def flood(self, seconds, kill_at_s=None, fmt='dnc'):
+        """`seconds` of sustained flood: tenants alpha/beta 8 threads
+        each, gamma 4 (~20 concurrent vs capacity 2x3 members = ~5x
+        when >= half the member slots serve partials), every request
+        carrying tenant + deadline_ms; optional SIGKILL of member b
+        at `kill_at_s`."""
+        import threading
+        docs = self.flood_docs(fmt)
+        counts = {t: {'completed': 0, 'shed': 0, 'transport': 0}
+                  for t in ('alpha', 'beta', 'gamma')}
+        lock = threading.Lock()
+        stop_at = time.monotonic() + seconds
+        slowest = [0.0]
+
+        def worker(tenant, tid):
+            i = 0
+            while time.monotonic() < stop_at:
+                case, doc = docs[(tid + i) % len(docs)]
+                i += 1
+                via = self.socks['a' if (tid + i) % 2 else 'c']
+                req = dict(doc, tenant=tenant, deadline_ms=20000)
+                t0 = time.monotonic()
+                try:
+                    rc, hd, out, err = mod_client.request_bytes(
+                        via, req, timeout_s=self.OP_GRACE_S + 15,
+                        pooled=True)
+                except (OSError, ValueError, DNError):
+                    # torn frames / broken pooled conns: a resolved,
+                    # clean transport failure — retry-safe, not a
+                    # violation
+                    with lock:
+                        counts[tenant]['transport'] += 1
+                        self.ops += 1
+                        slowest[0] = max(slowest[0],
+                                         time.monotonic() - t0)
+                    continue
+                dt = time.monotonic() - t0
+                with lock:
+                    self.ops += 1
+                    slowest[0] = max(slowest[0], dt)
+                if dt > self.OP_GRACE_S:
+                    self.violate('flood: request took %.1fs '
+                                 '(> deadline + grace)' % dt)
+                if rc == 0:
+                    gold = self.golden[(fmt, case)]
+                    if out != gold[1]:
+                        self.violate('flood: accepted request with '
+                                     'divergent bytes (%s)'
+                                     % ' '.join(case))
+                    with lock:
+                        counts[tenant]['completed'] += 1
+                    continue
+                text = err.decode('utf-8', 'replace')
+                if 'Traceback' in text or 'dn:' not in text:
+                    self.violate('flood: unclean rejection: %r'
+                                 % text[-300:])
+                    continue
+                if not hd.get('retryable'):
+                    self.violate('flood: non-retryable rejection '
+                                 'under overload: %r' % text[-200:])
+                    continue
+                if ('busy' in text or 'overloaded' in text) and \
+                        hd.get('retry_after_ms') is None:
+                    self.violate('flood: busy/overloaded rejection '
+                                 'without retry_after_ms')
+                    continue
+                with lock:
+                    counts[tenant]['shed'] += 1
+                    self.clean_errors += 1
+
+        threads = []
+        for tenant, n in (('alpha', 10), ('beta', 10), ('gamma', 4)):
+            for tid in range(n):
+                t = threading.Thread(target=worker,
+                                     args=(tenant, tid), daemon=True)
+                threads.append(t)
+                t.start()
+        if kill_at_s is not None:
+            time.sleep(kill_at_s)
+            self.proc_b.kill()
+            self.proc_b.wait()
+            self.note('SIGKILLed member b mid-flood')
+        for t in threads:
+            t.join(seconds + self.OP_GRACE_S + 30)
+            if t.is_alive():
+                self.violate('flood: worker thread hung')
+        return counts
+
+    def check_fairness(self, counts):
+        """Completion ratio alpha:beta within 2x of the 3:1 weights
+        (both tenants issued identical demand)."""
+        a = counts['alpha']['completed']
+        b = counts['beta']['completed']
+        shed = sum(c['shed'] for c in counts.values())
+        self.note('flood counts: %s (total shed %d)'
+                  % (counts, shed))
+        if shed == 0:
+            self.violate('flood never saturated the cluster: no '
+                         'request was shed at ~5x capacity')
+        if b < 3:
+            # too few completions to measure a ratio honestly: the
+            # flood is misconfigured for this rig
+            self.violate('flood: tenant beta completed only %d '
+                         'request(s); fairness unmeasurable' % b)
+            return
+        want = (self.TENANT_WEIGHTS['alpha'] /
+                float(self.TENANT_WEIGHTS['beta']))
+        ratio = a / float(b)
+        if not (want / 2.0 <= ratio <= want * 2.0):
+            self.violate('fairness: alpha:beta completion ratio '
+                         '%.2f outside 2x of configured %.1f'
+                         % (ratio, want))
+        else:
+            self.note('fairness ok: alpha:beta %.2f (configured '
+                      '%.1f)' % (ratio, want))
+        self.flood_counts = counts
+
+    def summary(self):
+        doc = super(OverloadSoak, self).summary()
+        doc['flood'] = getattr(self, 'flood_counts', {})
+        return doc
+
+
+def soak_overload(root, fast=False, verbose=True, floor=None):
+    """The overload drill under `root`; returns the summary dict."""
+    mod_faults.reset()
+    ctx = make_corpus(root, n=400 if fast else 1200,
+                      days=5 if fast else 10)
+    for fmt in FORMATS:
+        build(ctx, fmt)
+    os.environ.update({
+        'DN_ROUTER_PROBE_MS': '200', 'DN_ROUTER_FAILURES': '3',
+        'DN_ROUTER_COOLDOWN_MS': '500', 'DN_ROUTER_HEDGE_MS': '0',
+        'DN_ROUTER_FETCH_TIMEOUT_S': '30',
+        'DN_REMOTE_RETRIES': '2', 'DN_REMOTE_BACKOFF_MS': '10',
+        'DN_REMOTE_CONNECT_TIMEOUT_S': '5'})
+    s = OverloadSoak(ctx, verbose=verbose)
+    s.start_cluster()
+    prior_faults = os.environ.get('DN_FAULTS')
+    try:
+        s.note('fault-free flood-doc byte-equivalence check')
+        for fmt in FORMATS:
+            s.verify_doc_equivalence(fmt)
+        seconds = 12 if fast else 30
+        os.environ['DN_FAULTS'] = OVERLOAD_SPEC
+        mod_faults.reset()
+        s.note('multi-tenant flood (%ds, ~5x capacity, faults '
+               'armed [%s], SIGKILL of b mid-flood)'
+               % (seconds, OVERLOAD_SPEC))
+        counts = s.flood(seconds, kill_at_s=seconds / 2.0)
+        os.environ.pop('DN_FAULTS', None)
+        mod_faults.reset()
+        s.check_fairness(counts)
+        s.note('post-flood fault-free byte-identity round (b dead, '
+               'replicas serve)')
+        for fmt in FORMATS:
+            s.verify_doc_equivalence(fmt)
+    finally:
+        if prior_faults is None:
+            os.environ.pop('DN_FAULTS', None)
+        else:
+            os.environ['DN_FAULTS'] = prior_faults
+        s.stop_cluster()
+    return s.summary()
+
+
 # -- continuous-ingest (dn follow) drill ------------------------------------
 
 # the appender: grows the log in fsynced bursts so the follower's
@@ -1197,13 +1470,21 @@ def main(argv=None):
     p.add_argument('--follow', action='store_true',
                    help='run the continuous-ingest (dn follow) '
                         'drill instead of the single-process soak')
+    p.add_argument('--overload', action='store_true',
+                   help='run the multi-tenant overload flood '
+                        '(~5x capacity, tenant weights, torn-frame/'
+                        'stall/flood faults, mid-flood SIGKILL) '
+                        'instead of the single-process soak')
     p.add_argument('--min-faults', type=int, default=None,
                    help='required injected-fault floor '
                         '(default: 500, or 50 with --fast; the '
-                        'follow drill defaults to 100/20)')
+                        'follow drill defaults to 100/20, the '
+                        'overload drill to 60/15)')
     args = p.parse_args(argv)
     if args.follow:
         default_floor = 20 if args.fast else 100
+    elif args.overload:
+        default_floor = 15 if args.fast else 60
     else:
         default_floor = 50 if args.fast else 500
     floor = args.min_faults if args.min_faults is not None \
@@ -1212,7 +1493,8 @@ def main(argv=None):
     import tempfile
     t0 = time.time()
     runner = soak_cluster if args.cluster \
-        else soak_follow if args.follow else soak
+        else soak_follow if args.follow \
+        else soak_overload if args.overload else soak
     with tempfile.TemporaryDirectory(prefix='dn_soak_') as root:
         summary = runner(root, fast=args.fast, floor=floor)
     summary['elapsed_s'] = round(time.time() - t0, 1)
