@@ -6,7 +6,8 @@ PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
     bench-serve bench-cluster bench-follow bench-fanin soak-faults \
-    soak-cluster soak-follow soak-overload clean parity-matrix
+    soak-cluster soak-follow soak-overload soak-rebalance clean \
+    parity-matrix
 
 all: native
 
@@ -89,6 +90,15 @@ bench-follow: native
 # busy/overloaded rejections, fairness within 2x of weights
 soak-overload: native
 	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --overload
+
+# the live-resize drill: a serving cluster grows 3->5 and shrinks
+# 5->2 members under routed-query flood with armed handoff/topology
+# faults, joiners streaming their shards into EMPTY private trees,
+# a mid-handoff SIGKILL of a joiner (restart + idempotent re-pull)
+# and a donor SIGKILL mid-flood — asserts zero byte-diffs vs the
+# single-process goldens, zero dropped partitions, zero hangs
+soak-rebalance: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --rebalance
 
 # high fan-in: pooled persistent multiplexed connections vs
 # dial-per-request p50/p95 on the cluster partial path + shed-rate

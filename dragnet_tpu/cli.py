@@ -129,6 +129,15 @@ DN_OPTIONS = [
     (['socket'], 'string', None),
     (['time-field'], 'string', None),
     (['time-format'], 'string', None),
+    # `dn topo` dynamic-topology options: --topology names the
+    # coordinator file (defaults to DN_SERVE_TOPOLOGY), --wait bounds
+    # a readiness wait in seconds, --force commits an unready
+    # transition, --apply publishes a rebalance proposal.  Not in
+    # USAGE_TEXT (byte-pinned); documented in docs/serving.md.
+    (['topology'], 'string', None),
+    (['wait'], 'string', None),
+    (['force'], 'bool', None),
+    (['apply'], 'bool', None),
     # per-run request tracing (equivalent to DN_TRACE=stderr for one
     # command; composes with --remote — the client ships its trace id
     # and grafts the server's span subtree).  Not in USAGE_TEXT: the
@@ -1013,6 +1022,136 @@ def cmd_follow(ctx, argv):
         fatal(e)
 
 
+def cmd_topo(ctx, argv):
+    """`dn topo show|status|apply|commit|abort|rebalance
+    [--topology T.json] ...`: dynamic cluster topology management
+    (serve/coordinator.py, serve/rebalance.py).  `apply NEW.json`
+    publishes a pending epoch (members stream their newly-assigned
+    shards from the committed owners), `commit` cuts over atomically
+    once every member is handoff-ready (`--wait S` polls readiness,
+    `--force` overrides), `abort` withdraws the pending epoch, and
+    `rebalance` proposes partition moves toward load from the
+    members' live /stats (`--apply` publishes the proposal).  Not in
+    USAGE_TEXT — the usage output is byte-pinned to the reference
+    goldens; documented in docs/serving.md."""
+    import json
+    import os
+    opts = dn_parse_args(argv, ['topology', 'wait', 'force',
+                                'apply'])
+    if len(opts._args) < 1:
+        raise UsageError('missing topo subcommand')
+    sub = opts._args[0]
+    path = opts.topology or os.environ.get('DN_SERVE_TOPOLOGY') \
+        or None
+    if path is None:
+        raise UsageError('"--topology" (or DN_SERVE_TOPOLOGY) is '
+                         'required')
+    wait_s = None
+    if opts.wait is not None:
+        try:
+            wait_s = float(opts.wait)
+            if wait_s < 0:
+                raise ValueError(opts.wait)
+        except ValueError:
+            raise UsageError('bad value for "wait": "%s"'
+                             % opts.wait)
+    from .serve import coordinator as mod_coordinator
+    from .serve import topology as mod_topology
+    try:
+        if sub == 'show':
+            check_arg_count(opts, 1)
+            committed, pending = \
+                mod_topology.load_topology_state(path)
+            doc = {'committed': committed.summary()}
+            if pending is not None:
+                doc['pending'] = pending.summary()
+            sys.stdout.write(json.dumps(doc, indent=2,
+                                        sort_keys=True) + '\n')
+            return 0
+        if sub == 'status':
+            check_arg_count(opts, 1)
+            doc = mod_coordinator.transition_status(path)
+            sys.stdout.write(json.dumps(doc, indent=2,
+                                        sort_keys=True) + '\n')
+            return 0 if doc.get('ready') else 1
+        if sub == 'apply':
+            check_arg_count(opts, 2)
+            new_path = opts._args[1]
+            try:
+                with open(new_path, 'r') as f:
+                    new_doc = json.load(f)
+            except (OSError, ValueError) as e:
+                fatal(DNError('cannot read topology "%s": %s'
+                              % (new_path, e)))
+            committed, pending = mod_coordinator.begin_transition(
+                path, new_doc)
+            sys.stderr.write(
+                'dn topo: pending epoch %d published (committed '
+                'epoch %d; members hand off, then `dn topo '
+                'commit`)\n' % (pending.epoch, committed.epoch))
+            return 0
+        if sub == 'commit':
+            check_arg_count(opts, 1)
+            if wait_s:
+                status = mod_coordinator.wait_ready(
+                    path, timeout_s=wait_s)
+            else:
+                status = mod_coordinator.transition_status(path)
+            if not status.get('ready') and \
+                    not getattr(opts, 'force', None):
+                lag = [m for m, d in
+                       (status.get('members') or {}).items()
+                       if not d.get('ready')]
+                fatal(DNError(
+                    'transition to epoch %s not ready: member(s) %s '
+                    'still handing off (wait with --wait S, or '
+                    '--force to cut over anyway)'
+                    % (status.get('pending_epoch'),
+                       ','.join(sorted(lag)) or '?')))
+            committed = mod_coordinator.commit_transition(path)
+            sys.stderr.write('dn topo: epoch %d committed\n'
+                             % committed.epoch)
+            return 0
+        if sub == 'abort':
+            check_arg_count(opts, 1)
+            committed = mod_coordinator.abort_transition(path)
+            sys.stderr.write('dn topo: transition aborted '
+                             '(committed epoch %d stands)\n'
+                             % committed.epoch)
+            return 0
+        if sub == 'rebalance':
+            check_arg_count(opts, 1)
+            committed, pending = \
+                mod_topology.load_topology_state(path)
+            if pending is not None:
+                fatal(DNError('transition to epoch %d already '
+                              'pending; commit or abort it first'
+                              % pending.epoch))
+            from .serve import rebalance as mod_rebalance
+            loads = mod_rebalance.collect_loads(committed)
+            doc, decisions = mod_rebalance.propose_moves(committed,
+                                                         loads)
+            out = {'loads': loads, 'decisions': decisions,
+                   'proposed_epoch': doc['epoch'] if doc else None}
+            sys.stdout.write(json.dumps(out, indent=2,
+                                        sort_keys=True) + '\n')
+            if doc is None:
+                sys.stderr.write('dn topo: cluster balanced; '
+                                 'nothing to move\n')
+                return 0
+            if getattr(opts, 'apply', None):
+                mod_coordinator.begin_transition(
+                    path, doc, note={'rebalance': decisions})
+                sys.stderr.write(
+                    'dn topo: pending epoch %d published '
+                    '(%d move(s))\n' % (doc['epoch'],
+                                        len(decisions)))
+            return 0
+        raise UsageError('unknown topo subcommand: "%s"' % sub)
+    except DNError as e:
+        fatal(e)
+
+
 def cmd_serve(ctx, argv):
     """`dn serve --socket PATH | --port N [--pidfile P]
     [--cluster TOPOLOGY.json --member NAME] [--validate]`: the
@@ -1037,6 +1176,9 @@ def cmd_serve(ctx, argv):
     router_conf = mod_config.router_config()
     if isinstance(router_conf, DNError):
         fatal(router_conf)
+    topo_conf = mod_config.topo_config()
+    if isinstance(topo_conf, DNError):
+        fatal(topo_conf)
     faults_conf = mod_config.faults_config()
     if isinstance(faults_conf, DNError):
         fatal(faults_conf)
@@ -1050,11 +1192,16 @@ def cmd_serve(ctx, argv):
         raise UsageError('"--cluster" and "--member" must be used '
                          'together')
     topo = None
+    topo_pending = None
     if cluster is not None:
         from .serve import topology as mod_topology
         try:
-            topo = mod_topology.load_topology(cluster,
-                                              member=opts.member)
+            # a pending transition file loads as (committed, pending):
+            # the server serves the committed map and — when this
+            # member appears in the pending epoch — starts its shard
+            # handoff immediately (a fresh joiner's startup path)
+            topo, topo_pending = mod_topology.load_topology_state(
+                cluster, member=opts.member)
         except DNError as e:
             fatal(e)
 
@@ -1112,6 +1259,11 @@ def cmd_serve(ctx, argv):
                router_conf['cooldown_ms'], router_conf['hedge_ms'],
                router_conf['fetch_timeout_s'],
                router_conf['partial']))
+        sys.stdout.write(
+            'topo config ok: poll_ms=%d handoff_timeout_s=%d '
+            'handoff_retries=%d max_moves=%d\n'
+            % (topo_conf['poll_ms'], topo_conf['handoff_timeout_s'],
+               topo_conf['handoff_retries'], topo_conf['max_moves']))
         if topo is not None:
             sys.stdout.write(
                 'cluster topology ok: member=%s epoch=%d assign=%s '
@@ -1121,6 +1273,15 @@ def cmd_serve(ctx, argv):
                    ','.join(str(p)
                             for p in topo.partitions_of(opts.member))
                    or 'none'))
+            if topo_pending is not None:
+                sys.stdout.write(
+                    'cluster transition pending: epoch %d (owns: '
+                    '%s)\n'
+                    % (topo_pending.epoch,
+                       ','.join(str(p) for p in
+                                topo_pending.partitions_of(
+                                    opts.member))
+                       or 'none'))
         sites = faults_conf['sites']
         if sites:
             sys.stdout.write(
@@ -1135,7 +1296,9 @@ def cmd_serve(ctx, argv):
                                      port=port, pidfile=opts.pidfile,
                                      cluster=topo,
                                      member=opts.member,
-                                     router_conf=router_conf)
+                                     router_conf=router_conf,
+                                     pending=topo_pending,
+                                     topo_conf=topo_conf)
     except DNError as e:
         fatal(e)
 
@@ -1158,6 +1321,7 @@ COMMANDS = {
     'scan': cmd_scan,
     'serve': cmd_serve,
     'stats': cmd_stats,
+    'topo': cmd_topo,
 }
 
 

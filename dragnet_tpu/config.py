@@ -517,6 +517,58 @@ def router_config(env=None):
     return rv
 
 
+# --- dynamic-topology knobs (DN_TOPO_*) -------------------------------
+#
+# Same contract as the serve/router knobs: parsed and validated in one
+# place (serve/coordinator.py and serve/rebalance.py consume them;
+# `dn serve --validate` checks them up front).  Each entry: (env name,
+# kind, default, min).
+
+_TOPO_KNOBS = [
+    # topology-file poll cadence for live membership: a cluster member
+    # re-reads its --cluster file at this period and applies epoch
+    # changes while serving.  0 (the default) disables polling — the
+    # topology is static for the life of the process, exactly the
+    # PR 8 behavior.
+    ('DN_TOPO_POLL_MS', 'int', 0, 0),
+    # per-shard-fetch wall-clock bound during partition handoff (a
+    # wedged donor must cost the joiner a bounded wait, never a hang)
+    ('DN_TOPO_HANDOFF_TIMEOUT_S', 'int', 120, 1),
+    # per-shard retry budget across donor replicas before the handoff
+    # records a failure for that shard
+    ('DN_TOPO_HANDOFF_RETRIES', 'int', 2, 0),
+    # rebalance planner: maximum partition moves per proposed epoch
+    # (small steps keep each handoff window short)
+    ('DN_TOPO_MAX_MOVES', 'int', 2, 1),
+]
+
+
+def topo_config(env=None):
+    """The resolved DN_TOPO_* knob dict (keys: poll_ms,
+    handoff_timeout_s, handoff_retries, max_moves), or DNError on the
+    first malformed value — the shared fail-fast contract `dn serve
+    --validate` checks."""
+    if env is None:
+        env = os.environ
+    rv = {}
+    for name, kind, default, minimum in _TOPO_KNOBS:
+        key = name[len('DN_TOPO_'):].lower()
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        if value < minimum:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        rv[key] = value
+    return rv
+
+
 # --- continuous-ingest knobs (DN_FOLLOW_*) ----------------------------
 #
 # Same contract as the serve/remote knobs: parsed and validated in one

@@ -70,6 +70,10 @@ SITES = (
     'follow.read',      # dn follow: tailer source reads
     'follow.checkpoint',  # dn follow: checkpoint tmp write
     'follow.publish',   # dn follow: batch publish (pre-commit)
+    'topo.poll',        # dynamic topology: coordinator-file poll
+    'handoff.manifest',  # handoff: donor shard-manifest build
+    'handoff.fetch',    # handoff: joiner per-shard fetch
+    'handoff.apply',    # handoff: joiner shard rename-into-place
 )
 
 

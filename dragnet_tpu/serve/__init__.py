@@ -6,10 +6,17 @@ audition verdicts, compiled device executables — and executes
 scan/build/query requests over a newline-JSON socket protocol with
 byte-identical output framing.  Modules:
 
-* server.py    — the multi-threaded daemon + request execution
-* admission.py — bounded admission, deadlines, request coalescing
-* client.py    — the `--remote` thin client with local fallback
-* lifecycle.py — pidfile/socket hygiene, drain, writer invalidation
+* server.py      — the multi-threaded daemon + request execution
+* admission.py   — bounded admission, deadlines, request coalescing
+* client.py      — the `--remote` thin client with local fallback
+* lifecycle.py   — pidfile/socket hygiene, drain, writer invalidation
+* topology.py    — the cluster map: members, partitions, epochs
+* router.py      — scatter-gather routing, breakers, failover
+* coordinator.py — dynamic topology: epoch publication + watcher
+* rebalance.py   — partition handoff (shard streaming) + planner
+* protocol.py    — wire framing (v1 and multiplexed v2)
+* ioloop.py      — the selector connection front end
+* pool.py        — pooled persistent multiplexed client connections
 
 Import-light on purpose: the heavy modules load lazily so `import
 dragnet_tpu` stays cheap.

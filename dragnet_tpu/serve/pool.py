@@ -252,7 +252,7 @@ class ConnectionPool(object):
         self._v1 = set()
         self._pid = None
         self.counters = {'dials': 0, 'reuses': 0, 'downgrades': 0,
-                         'invalidated': 0}
+                         'invalidated': 0, 'evicted': 0}
 
     def _bump(self, name, n=1):
         with self._lock:
@@ -335,6 +335,24 @@ class ConnectionPool(object):
                 self._v1.add(endpoint)
                 self.counters['downgrades'] += 1
         counter_bump('remote pool v1 downgrades')
+
+    def close_endpoint(self, endpoint):
+        """Retire an endpoint that left the serving topology: its
+        pooled connection closes NOW (waking the demux reader and any
+        parked waiters with a clean pre-commit error) and its
+        v1-downgrade memory drops, so a member re-added later starts
+        fresh.  Without this, a departed member's socket, reader
+        thread, and downgrade verdict linger until process exit.
+        Returns True when a live connection was actually closed."""
+        with self._lock:
+            conn = self._conns.pop(endpoint, None)
+            self._v1.discard(endpoint)
+            if conn is not None:
+                self.counters['evicted'] += 1
+        if conn is not None:
+            conn._fail_all(OSError('endpoint removed from topology'))
+            return True
+        return False
 
     def reset(self):
         with self._lock:

@@ -47,6 +47,16 @@ Every decision lands in the obs layer: router_* counters and the
 router_partial_ms histogram (which also feeds the hedge delay),
 router.scatter/router.partial/router.merge spans, and the /stats
 `cluster` section (serve/server.py).
+
+Dynamic topology (serve/coordinator.py): the serving map can change
+while the router runs.  update_topology() swaps the map atomically —
+departed members' prober threads stop and their pooled connections
+close/evict (no thread or fd leak, no log-noise probing of dead
+endpoints), new members get fresh states and probers.  Every scatter
+snapshots ONE topology (a whole query is answered under exactly one
+epoch — never a mix of partition maps), and a member that answers a
+partial with an epoch-mismatch rejection raises TopologyEpochError so
+the server can re-fetch the current map and retry the scatter.
 """
 
 import json
@@ -80,6 +90,20 @@ class RouterPartitionError(DNError):
         # up to the client — shed != down, and the client should back
         # off exactly as long as the most loaded member asked
         self.retry_after_ms = retry_after_ms
+
+
+class TopologyEpochError(DNError):
+    """A member rejected a partial because it serves a NEWER topology
+    epoch than the one this scatter ran under: the router's map is
+    stale.  Retryable — the server re-polls the coordinator source
+    and retries the whole scatter under the refreshed map."""
+
+    def __init__(self, detail, current_epoch=None):
+        super(TopologyEpochError, self).__init__(
+            'topology epoch stale during scatter: %s' % detail)
+        self.retryable = True
+        self.epoch_mismatch = True
+        self.current_epoch = current_epoch
 
 
 class _BreakerOpen(Exception):
@@ -168,6 +192,10 @@ class MemberState(object):
         self.lock = threading.Lock()
         self.draining = False
         self.last_ok = None        # monotonic of last good signal
+        # set when the member leaves the topology: its prober thread
+        # exits at the next wakeup instead of probing a dead endpoint
+        # forever (the pre-dynamic-topology leak)
+        self.gone = threading.Event()
 
     def note_health(self, doc):
         ok = bool(doc.get('ok'))
@@ -259,7 +287,11 @@ class Router(object):
                 name, topology.endpoint(name),
                 Breaker(conf['failures'], conf['cooldown_ms']))
         self._stop = threading.Event()
-        self._probers = None
+        self._prober_started = False
+        self._prober_threads = []
+        # serializes topology swaps against each other; scatters
+        # never take it — they snapshot self.topo once per scatter
+        self._swap_lock = threading.Lock()
         self._lock = threading.Lock()
         self._counters = {'scatters': 0, 'partials_local': 0,
                           'partials_remote': 0, 'failovers': 0,
@@ -267,7 +299,9 @@ class Router(object):
                           'hedges_wasted': 0, 'degraded': 0,
                           'partial_responses': 0,
                           'breaker_skips': 0,
-                          'breaker_forced_dials': 0}
+                          'breaker_forced_dials': 0,
+                          'epoch_updates': 0,
+                          'epoch_mismatches': 0}
         # the hedge-delay source: observed partial latencies (also
         # exported through the typed registry as router_partial_ms)
         self._latency = obs_metrics.Histogram()
@@ -276,34 +310,88 @@ class Router(object):
     # -- lifecycle --------------------------------------------------------
 
     def start(self):
-        if self._probers is None:
+        if not self._prober_started:
             # ONE prober thread per member: a probe of a hard-down
             # TCP member can block for the client's full retry
             # budget, and a shared serial sweep would starve every
             # other member's breaker/draining freshness of exactly
             # the signal DN_ROUTER_PROBE_MS promises
-            self._probers = []
-            for name in self.topo.member_names():
-                t = threading.Thread(
-                    target=self._probe_loop, args=(name,),
-                    name='dn-router-probe-%s' % name, daemon=True)
-                t.start()
-                self._probers.append(t)
+            self._prober_started = True
+            with self._swap_lock:
+                for name, st in list(self.states.items()):
+                    self._start_prober(name, st)
         return self
 
     def stop(self):
         self._stop.set()
-        for t in self._probers or []:
+        for st in list(self.states.values()):
+            st.gone.set()
+        for t in self._prober_threads:
             t.join(2.0)
-        self._probers = None
+        self._prober_threads = []
+        self._prober_started = False
+
+    def update_topology(self, topology):
+        """Swap the serving map while live (the dynamic-topology
+        cutover).  Departed members are retired — prober thread
+        stopped (MemberState.gone), pooled connection closed and
+        evicted — new members get fresh states (and probers when
+        probing runs), and a retained member whose endpoint moved
+        drops its old connection.  In-flight scatters finish on the
+        topology they snapshotted; members that already cut over
+        reject them with the epoch-mismatch contract and the server
+        retries under the new map."""
+        from . import pool as mod_pool
+        with self._swap_lock:
+            new_names = set(topology.member_names())
+            kept_endpoints = {topology.endpoint(n)
+                              for n in new_names}
+            for name in list(self.states):
+                if name in new_names:
+                    continue
+                st = self.states.pop(name)
+                st.gone.set()
+                if st.endpoint not in kept_endpoints:
+                    mod_pool.get().close_endpoint(st.endpoint)
+            for name in sorted(new_names):
+                st = self.states.get(name)
+                if st is None:
+                    st = MemberState(
+                        name, topology.endpoint(name),
+                        Breaker(self.conf['failures'],
+                                self.conf['cooldown_ms']))
+                    self.states[name] = st
+                    if self._prober_started:
+                        self._start_prober(name, st)
+                elif st.endpoint != topology.endpoint(name):
+                    old_ep = st.endpoint
+                    st.endpoint = topology.endpoint(name)
+                    if old_ep not in kept_endpoints:
+                        mod_pool.get().close_endpoint(old_ep)
+            self.topo = topology
+        self._bump('epoch_updates')
+        obs_trace.event('router.topology', epoch=topology.epoch)
 
     # -- health probing ---------------------------------------------------
 
-    def _probe_loop(self, name):
+    def _start_prober(self, name, st):
+        # call with _swap_lock held.  Prune exited probers (departed
+        # members') first — a long-lived member under topology churn
+        # must not accumulate dead Thread objects forever
+        self._prober_threads = [t for t in self._prober_threads
+                                if t.is_alive()]
+        t = threading.Thread(
+            target=self._probe_loop, args=(name, st),
+            name='dn-router-probe-%s' % name, daemon=True)
+        t.start()
+        self._prober_threads.append(t)
+
+    def _probe_loop(self, name, st):
         from . import client as mod_client
         period = self.conf['probe_ms'] / 1000.0
-        st = self.states[name]
-        while not self._stop.wait(period):
+        while not st.gone.wait(period):
+            if self._stop.is_set():
+                return
             if name == self.member:
                 st.note_health({'ok': True,
                                 'draining': self.self_draining()})
@@ -311,7 +399,7 @@ class Router(object):
             doc = mod_client.health(st.endpoint,
                                     timeout_s=min(
                                         5.0, period * 4 + 1.0))
-            if self._stop.is_set():
+            if self._stop.is_set() or st.gone.is_set():
                 return
             st.note_health(doc)
 
@@ -319,7 +407,7 @@ class Router(object):
         """One synchronous probe sweep (tests, and a cold router that
         wants member state before its first scatter)."""
         from . import client as mod_client
-        for name, st in self.states.items():
+        for name, st in list(self.states.items()):
             if name == self.member:
                 st.note_health({'ok': True,
                                 'draining': self.self_draining()})
@@ -374,7 +462,11 @@ class Router(object):
         list — a last-resort member is still better than a degraded
         response."""
         def score(name):
-            st = self.states[name]
+            st = self.states.get(name)
+            if st is None:
+                # left the topology mid-scatter: worst rank, and the
+                # dial itself fails cleanly into the failover path
+                return (3, 1, replicas.index(name))
             snap = st.breaker.snapshot()
             with st.lock:
                 draining = st.draining
@@ -411,7 +503,9 @@ class Router(object):
             self._bump('partials_local')
             self._observe_latency((time.monotonic() - t0) * 1000.0)
             return shards
-        st = self.states[name]
+        st = self.states.get(name)
+        if st is None:
+            raise DNError('member "%s" left the topology' % name)
         if not force and not st.breaker.allow():
             self._bump('breaker_skips')
             raise _BreakerOpen(name)
@@ -441,6 +535,13 @@ class Router(object):
             if header.get('retryable'):
                 e.retryable = True
                 e.retry_after_ms = header.get('retry_after_ms')
+            hstats = header.get('stats') or {}
+            if hstats.get('epoch_mismatch'):
+                # the member serves a different epoch than this
+                # scatter's snapshot: surfaced so scatter() can tell
+                # a stale MAP from a dead member
+                e.epoch_mismatch = True
+                e.current_epoch = hstats.get('current_epoch')
             raise e
         st.breaker.record_success()
         try:
@@ -453,13 +554,13 @@ class Router(object):
         self._observe_latency((time.monotonic() - t0) * 1000.0)
         return shards
 
-    def _fetch_partition(self, pid, partial_req, scope):
-        """Fetch one partition's partial with failover + hedging.
-        Returns the shard list; raises DNError when every replica
-        failed."""
+    def _fetch_partition(self, pid, partial_req, scope, topo):
+        """Fetch one partition's partial with failover + hedging
+        under the scatter's topology snapshot `topo`.  Returns the
+        shard list; raises DNError when every replica failed."""
         with mod_vpipe.adopt_scope(scope):
             mod_faults.fire('router.dispatch')
-            ranked = self._rank(self.topo.replicas(pid))
+            ranked = self._rank(topo.replicas(pid))
             timeout_s = self.conf['fetch_timeout_s']
             if partial_req.get('deadline_ms'):
                 # a propagated deadline bounds the fetch too: waiting
@@ -570,6 +671,17 @@ class Router(object):
         hints = [h for h in hints if h is not None]
         if hints:
             e.retry_after_ms = max(hints)
+        mism = [x for x in errors
+                if getattr(x, 'epoch_mismatch', False)]
+        if mism:
+            # at least one replica is serving a different epoch: the
+            # scatter's map may be stale, not the partition dead
+            e.epoch_mismatch = True
+            epochs = [getattr(x, 'current_epoch', None)
+                      for x in mism]
+            epochs = [v for v in epochs if isinstance(v, int)]
+            if epochs:
+                e.current_epoch = max(epochs)
         raise e
 
     # -- scatter-gather ---------------------------------------------------
@@ -589,13 +701,18 @@ class Router(object):
         from ..vpipe import Pipeline
 
         self._bump('scatters')
-        pids = self.topo.partition_ids()
+        # ONE topology snapshot per scatter: every partial of this
+        # query runs under the same epoch's partition map, so the
+        # merge can never mix two epochs' shard assignments even
+        # while a cutover swaps self.topo mid-flight
+        topo = self.topo
+        pids = topo.partition_ids()
         partial_req = {
             'op': 'query_partial', 'ds': dsname,
             'config': req.get('config'),
             'interval': interval,
             'queryconfig': req.get('queryconfig'),
-            'epoch': self.topo.epoch,
+            'epoch': topo.epoch,
         }
         if req.get('tenant'):
             # fairness identity rides the hop: a member under load
@@ -614,7 +731,8 @@ class Router(object):
         def fetch(pid):
             preq = dict(partial_req, partitions=[pid])
             try:
-                shards = self._fetch_partition(pid, preq, scope)
+                shards = self._fetch_partition(pid, preq, scope,
+                                               topo)
                 with lock:
                     results[pid] = shards
             except DNError as e:
@@ -641,6 +759,22 @@ class Router(object):
 
         missing = sorted(failures)
         if missing:
+            mism = [p for p in missing
+                    if getattr(failures[p], 'epoch_mismatch', False)]
+            if mism:
+                # a member is on a different epoch: this is OUR map
+                # being stale, not a dead partition — raise the
+                # resync signal instead of a degraded result in
+                # EITHER partial mode (serving a partial merge under
+                # a stale map could drop partitions that moved)
+                self._bump('epoch_mismatches')
+                obs_metrics.inc('topo_epoch_mismatch_total')
+                epochs = [getattr(failures[p], 'current_epoch', None)
+                          for p in mism]
+                epochs = [v for v in epochs if isinstance(v, int)]
+                raise TopologyEpochError(
+                    failures[mism[0]].message,
+                    current_epoch=max(epochs) if epochs else None)
             self._bump('degraded')
             detail = '; '.join(
                 failures[p].message for p in missing[:2])

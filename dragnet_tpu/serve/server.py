@@ -240,7 +240,7 @@ def device_engaged(counters):
 class DnServer(object):
     def __init__(self, socket_path=None, port=None, host='127.0.0.1',
                  conf=None, pidfile=None, cluster=None, member=None,
-                 router_conf=None):
+                 router_conf=None, pending=None, topo_conf=None):
         if conf is None:
             conf = mod_config.serve_config()
         if isinstance(conf, DNError):
@@ -258,6 +258,26 @@ class DnServer(object):
         self.cluster = cluster
         self.member = member
         self.router = None
+        # dynamic topology (serve/coordinator.py): the committed map
+        # can be swapped while serving, a pending epoch streams its
+        # handoff (serve/rebalance.py), and DN_TOPO_POLL_MS > 0 polls
+        # the topology file for both
+        if topo_conf is None:
+            topo_conf = mod_config.topo_config()
+        if isinstance(topo_conf, DNError):
+            raise topo_conf
+        self.topo_conf = topo_conf
+        self.pending = None
+        self._initial_pending = pending
+        self.puller = None
+        self.topo_watcher = None
+        self.topo_leaving = False
+        self._topo_lock = threading.Lock()
+        self._topo_counters = {'transitions': 0,
+                               'mismatch_rejections': 0,
+                               'resyncs': 0,
+                               'handoff_rejections': 0,
+                               'handoff_retries': 0}
         if cluster is not None:
             from . import router as mod_router
             self.router = mod_router.Router(
@@ -342,6 +362,20 @@ class DnServer(object):
         self._hook = mod_lifecycle.install_writer_invalidation()
         if self.router is not None:
             self.router.start()
+        if self.cluster is not None:
+            obs_metrics.set_gauge('topo_epoch', self.cluster.epoch)
+            if self._initial_pending is not None:
+                # started mid-transition (e.g. a fresh joiner): begin
+                # the handoff immediately
+                self.apply_topology(self.cluster,
+                                    self._initial_pending)
+                self._initial_pending = None
+            if self.cluster.path and self.topo_conf['poll_ms'] > 0:
+                from . import coordinator as mod_coordinator
+                self.topo_watcher = mod_coordinator.TopologyWatcher(
+                    self, self.cluster.path,
+                    self.topo_conf['poll_ms'],
+                    log=self.log).start()
         self.log.info('listening',
                       socket=self.socket_path, port=self.bound_port,
                       member=self.member,
@@ -398,6 +432,10 @@ class DnServer(object):
         # flush queued response bytes (the draining rejections the
         # workers just framed included), then close every connection
         self.loop.shutdown(max(1.0, deadline - time.monotonic() + 1))
+        if self.topo_watcher is not None:
+            self.topo_watcher.stop()
+        if self.puller is not None:
+            self.puller.stop()
         if self.router is not None:
             self.router.stop()
         # flush warm state cleanly: cached shard handles hold open
@@ -412,6 +450,206 @@ class DnServer(object):
         _SERVER_LEAKS.untrack(self)
         self._drained.set()
         self.log.info('drained', requests=self._counters['requests'])
+
+    # -- dynamic topology -------------------------------------------------
+
+    def apply_topology(self, committed, pending):
+        """The live-membership cutover (TopologyWatcher calls this on
+        every observed change; also called at bind for a server
+        started mid-transition).  Idempotent: same-epoch re-applies
+        are no-ops.  A committed epoch bump swaps the serving map
+        atomically (router probers/pool conns for departed members
+        retire); a pending epoch starts the shard handoff."""
+        if self.cluster is None:
+            return
+        with self._topo_lock:
+            if committed.epoch > self.cluster.epoch:
+                self.cluster = committed
+                self.topo_leaving = \
+                    self.member not in committed.members
+                if self.router is not None:
+                    self.router.update_topology(committed)
+                self._topo_counters['transitions'] += 1
+                obs_metrics.inc('topo_epoch_transitions_total')
+                obs_metrics.set_gauge('topo_epoch', committed.epoch)
+                self.log.info('topology committed',
+                              epoch=committed.epoch,
+                              leaving=self.topo_leaving)
+            if pending is not None and \
+                    pending.epoch > self.cluster.epoch:
+                # dedupe by CONTENT, not epoch number: an abort
+                # followed by a re-apply reuses committed+1, and a
+                # member that only saw the final file must not keep
+                # the withdrawn map's handoff state (serving the new
+                # assignments with the old pull's shards would be a
+                # silently short shard set)
+                if self.pending is None or \
+                        self.pending.epoch != pending.epoch or \
+                        self.pending.doc() != pending.doc():
+                    self.pending = pending
+                    obs_metrics.set_gauge('topo_pending_epoch',
+                                          pending.epoch)
+                    self._start_handoff(self.cluster, pending)
+                    self.log.info('topology pending',
+                                  epoch=pending.epoch)
+            elif self.pending is not None and \
+                    (pending is None or
+                     self.pending.epoch <= self.cluster.epoch):
+                # resolved: committed (the puller's ready flag keeps
+                # gating until its pull finishes) or aborted
+                resolved = self.pending
+                self.pending = None
+                obs_metrics.set_gauge('topo_pending_epoch', 0)
+                if pending is None and self.puller is not None and \
+                        self.puller.target_epoch == resolved.epoch \
+                        and resolved.epoch > self.cluster.epoch:
+                    # aborted outright: stop a pull for the withdrawn
+                    # epoch (streamed shards are harmless litter the
+                    # partition filter ignores)
+                    self.puller.stop()
+                    self.puller = None
+
+    def _start_handoff(self, committed, pending):
+        """Spawn the shard puller for a pending epoch (call with
+        _topo_lock held).  Members LEAVING in the pending map pull
+        nothing — they are demoted (health reports draining) and
+        removed only after the commit, when ownership has moved."""
+        if self.member is None or self.member not in pending.members:
+            if self.puller is not None:
+                self.puller.stop()
+            self.puller = None
+            return
+        from . import rebalance as mod_rebalance
+        if self.puller is not None:
+            self.puller.stop()
+        self.puller = mod_rebalance.HandoffPuller(
+            committed, pending, self.member,
+            topo_conf=self.topo_conf, log=self.log).start()
+
+    def retry_failed_handoff(self):
+        """Restart a FAILED pull for the still-pending epoch (the
+        watcher calls this every poll): a donor that was transiently
+        unreachable past the retry budget must not wedge the
+        transition until a process restart.  One attempt per poll,
+        never concurrent (only a finished, failed puller restarts);
+        a pull left failed after a forced early commit is out of
+        scope — its donors have moved epochs and the operator
+        explicitly chose the degraded window."""
+        with self._topo_lock:
+            puller, pending = self.puller, self.pending
+            if pending is None or puller is None or \
+                    puller.target_epoch != pending.epoch:
+                return False
+            if puller.ready or not puller.failed or \
+                    not puller.wait(0):
+                return False
+            self._topo_counters['handoff_retries'] = \
+                self._topo_counters.get('handoff_retries', 0) + 1
+            self.log.info('retrying failed handoff',
+                          epoch=pending.epoch, error=puller.error)
+            self._start_handoff(self.cluster, pending)
+            return True
+
+    def _topo_leaving_now(self):
+        """Demotion signal: True once this member is absent from the
+        pending map (leaving as soon as the transition starts, per
+        the demote-then-remove contract) or from the committed map
+        (already removed)."""
+        with self._topo_lock:
+            if self.cluster is None:
+                return False
+            if self.topo_leaving:
+                return True
+            return self.pending is not None and \
+                self.member not in self.pending.members
+
+    def _serving_for_epoch(self, epoch, pids=None):
+        """The topology a partial at `epoch` executes under, with the
+        epoch-mismatch and handoff gates applied.  Accepts the
+        committed epoch always, and the pending epoch during a
+        transition window (commits propagate asynchronously — a
+        router that saw the commit first must not be reject-stormed
+        by members that have not polled yet).  Raises the retryable
+        mismatch/handoff-incomplete DNErrors otherwise."""
+        with self._topo_lock:
+            committed, pending = self.cluster, self.pending
+            puller = self.puller
+        serving = None
+        if epoch == committed.epoch:
+            serving = committed
+        elif pending is not None and epoch == pending.epoch:
+            serving = pending
+        if serving is None:
+            with self._topo_lock:
+                self._topo_counters['mismatch_rejections'] += 1
+            obs_metrics.inc('topo_epoch_mismatch_total')
+            have = str(committed.epoch)
+            if pending is not None:
+                have += '/pending %d' % pending.epoch
+            e = DNError('topology epoch mismatch (member has %s, '
+                        'router sent %s)' % (have, epoch))
+            e.retryable = True
+            e.epoch_mismatch = True
+            e.current_epoch = committed.epoch
+            raise e
+        if puller is not None and not puller.ready and \
+                puller.target_epoch == epoch and pids is not None and \
+                (set(pids) & puller.affected_pids):
+            # this member's shards for the requested partitions are
+            # still streaming in: serving now would return a SHORT
+            # shard set with rc=0 — reject retryably instead (the
+            # router fails over to a replica that has the bytes)
+            with self._topo_lock:
+                self._topo_counters['handoff_rejections'] += 1
+            e = DNError('handoff incomplete for partition(s) %s '
+                        '(epoch %d): shards still streaming'
+                        % (','.join(str(p) for p in sorted(
+                            set(pids) & puller.affected_pids)),
+                           epoch))
+            e.retryable = True
+            raise e
+        return serving
+
+    def topology_doc(self):
+        """The /stats `topology` section and the `topology` op body:
+        current/pending epochs, handoff progress, transition
+        counters, watcher telemetry — what the coordinator polls for
+        commit readiness and dashboards scrape."""
+        with self._topo_lock:
+            committed, pending = self.cluster, self.pending
+            puller = self.puller
+            counters = dict(self._topo_counters)
+        doc = {'member': self.member,
+               'configured': committed is not None}
+        if committed is None:
+            return doc
+        doc.update({
+            'epoch': committed.epoch,
+            'state': 'pending' if pending is not None
+            else 'committed',
+            'pending_epoch': pending.epoch
+            if pending is not None else None,
+            'leaving': self._topo_leaving_now(),
+            'source': committed.path,
+            'poll_ms': self.topo_conf['poll_ms'],
+            'partitions_owned':
+            committed.partitions_of(self.member),
+            'counters': counters,
+        })
+        doc['handoff'] = puller.status() if puller is not None \
+            else None
+        if pending is not None:
+            ready = puller is not None and \
+                puller.target_epoch == pending.epoch and puller.ready
+            doc['handoff_ready'] = ready
+            note = getattr(pending, 'note', None)
+            if note is not None:
+                doc['pending_note'] = note
+        else:
+            doc['handoff_ready'] = puller is None or puller.ready
+        if self.topo_watcher is not None:
+            doc['watcher'] = self.topo_watcher.stats()
+        return doc
 
     # -- stats ------------------------------------------------------------
 
@@ -474,6 +712,11 @@ class DnServer(object):
             # scatter-gather observability: per-member breaker
             # states, failover/hedge/degraded counters (router.py)
             doc['cluster'] = self.router.stats_doc()
+        if self.cluster is not None:
+            # dynamic-topology observability: current/pending epoch,
+            # handoff progress, transition counters
+            # (serve/coordinator.py, serve/rebalance.py)
+            doc['topology'] = self.topology_doc()
         from ..follow import stats_doc as follow_stats
         fs = follow_stats()
         if fs is not None:
@@ -649,8 +892,14 @@ class DnServer(object):
             # _handle_conn, which drops the connection — exactly what
             # a dead member looks like to a prober).
             mod_faults.fire('member.health')
+            # a member LEAVING the topology (absent from the pending
+            # or committed map) reports draining so routers demote it
+            # — but stays ok (healthy, still serving) so the breaker
+            # never churns on an orderly departure
+            leaving = self._topo_leaving_now()
             doc = {
-                'ok': not self.draining, 'draining': self.draining,
+                'ok': not self.draining,
+                'draining': self.draining or leaving,
                 'pid': os.getpid(),
                 'uptime_s': round(time.monotonic() - self._t0, 3),
                 'inflight': self.admission.depth(),
@@ -658,11 +907,19 @@ class DnServer(object):
             if self.cluster is not None:
                 doc['member'] = self.member
                 doc['epoch'] = self.cluster.epoch
+                if self.pending is not None:
+                    doc['pending_epoch'] = self.pending.epoch
             body = json.dumps(doc, sort_keys=True) + '\n'
             return 0, body.encode(), b'', {}
         if op == 'stats':
             body = json.dumps(self.stats_doc(), sort_keys=True,
                               indent=2) + '\n'
+            return 0, body.encode(), b'', {}
+        if op == 'topology':
+            # the dynamic-topology status op (coordinator readiness
+            # polls, `dn topo status`): tiny, never queued
+            body = json.dumps(self.topology_doc(),
+                              sort_keys=True) + '\n'
             return 0, body.encode(), b'', {}
         if op == 'metrics':
             # Prometheus text exposition of the typed registry (the
@@ -674,7 +931,8 @@ class DnServer(object):
         if op == 'build' and req.get('idempotency'):
             return self._execute_idempotent(req['idempotency'], req,
                                             tenant, deadline_at)
-        if op in ('scan', 'query', 'build', 'query_partial') or \
+        if op in ('scan', 'query', 'build', 'query_partial',
+                  'shard_manifest', 'shard_fetch') or \
                 (op == '_sleep' and
                  os.environ.get('DN_SERVE_TEST_OPS') == '1'):
             return self._execute_data(req, tenant=tenant,
@@ -810,6 +1068,12 @@ class DnServer(object):
                     mp = getattr(e, 'missing_partitions', None)
                     if mp is not None:
                         flags['missing'] = list(mp)
+                    if getattr(e, 'epoch_mismatch', False):
+                        # the rejection names OUR epoch so the peer
+                        # can tell a stale map from a dead member
+                        flags['epoch_mismatch'] = True
+                        flags['current_epoch'] = \
+                            getattr(e, 'current_epoch', None)
                     if getattr(e, 'retryable', False):
                         flags['retryable_error'] = True
                         # degraded-because-shedding: the members'
@@ -939,6 +1203,12 @@ class DnServer(object):
             extra['missing_partitions'] = flags['missing']
             if rc == 0:
                 extra['partial'] = True
+        if flags.get('epoch_mismatch'):
+            # the stale-router resync signal: the rejected peer
+            # re-fetches the current map and retries
+            extra['epoch_mismatch'] = True
+            if flags.get('current_epoch') is not None:
+                extra['current_epoch'] = flags['current_epoch']
         return rc, out, err, finish_obs(rc, extra)
 
     def _tree_lock(self, ds, dsname):
@@ -970,6 +1240,26 @@ class DnServer(object):
 
         from .. import datasource_for_name, metrics_for_index
         cfg_path = req.get('config') or None
+        if self.cluster is not None and \
+                op in ('query_partial', 'shard_manifest',
+                       'shard_fetch'):
+            # per-member index trees: when the topology declares this
+            # member's own config, partition-scoped work resolves
+            # datasources through IT — the request's config names the
+            # router's view of the world, not ours.  Without the
+            # declaration, a query partial keeps the request's config
+            # (byte-identical to the PR 8 shared-tree contract), but
+            # the handoff ops always resolve the DONOR's own view
+            # (process default) — a joiner's request config points at
+            # its empty tree, and enumerating that as the donor would
+            # silently hand off nothing.
+            override = self.cluster.member_config(self.member)
+            if override is None and self.pending is not None:
+                override = self.pending.member_config(self.member)
+            if override:
+                cfg_path = override
+            elif op in ('shard_manifest', 'shard_fetch'):
+                cfg_path = None
         backend = mod_config.ConfigBackendLocal(cfg_path)
         err, config = backend.load()
         if err is not None and not getattr(err, 'is_enoent', False):
@@ -986,6 +1276,10 @@ class DnServer(object):
         if op == 'query_partial':
             return self._run_partial(req, ds, dsname, opts, backend,
                                      flags)
+        if op == 'shard_manifest':
+            return self._run_shard_manifest(req, ds, dsname, flags)
+        if op == 'shard_fetch':
+            return self._run_shard_fetch(req, ds, dsname, flags)
         if op == 'query' and self.router is not None and \
                 not opts.dry_run:
             # cluster mode: this member routes — scatter the query to
@@ -1061,8 +1355,22 @@ class DnServer(object):
         # degraded errors (RouterPartitionError) propagate as DNError
         # with their missing_partitions/retryable attrs intact — the
         # job() handler frames the message and marks the header
-        (result, missing), shared = self.coalescer.run(key, compute,
-                                                       lease=flags)
+        from . import router as mod_router
+        try:
+            (result, missing), shared = self.coalescer.run(
+                key, compute, lease=flags)
+        except mod_router.TopologyEpochError:
+            # a member rejected the scatter as stale: re-fetch the
+            # current map (synchronously, when a watcher runs) and
+            # retry ONCE under the refreshed topology — the straggler
+            # self-heals instead of erroring to the client
+            with self._topo_lock:
+                self._topo_counters['resyncs'] += 1
+            obs_metrics.inc('topo_resyncs_total')
+            if self.topo_watcher is not None:
+                self.topo_watcher.poll_now()
+            (result, missing), shared = self.coalescer.run(
+                key, compute, lease=flags)
         flags['coalesced'] = shared
         if missing:
             flags['missing'] = list(missing)
@@ -1082,20 +1390,22 @@ class DnServer(object):
             mod_cli.fatal(DNError(
                 'not a cluster member (start with '
                 '--cluster/--member)'))
-        epoch = req.get('epoch')
-        if epoch != self.cluster.epoch:
-            # a router running a different topology file must never
-            # merge this member's partitions: clean retryable error
-            e = DNError('topology epoch mismatch (member has %d, '
-                        'router sent %s)'
-                        % (self.cluster.epoch, epoch))
-            e.retryable = True
-            raise e
         pids = req.get('partitions')
-        known = set(self.cluster.partition_ids())
         if not isinstance(pids, list) or not pids or \
-                not all(isinstance(p, int) and not isinstance(p, bool)
-                        and p in known for p in pids):
+                not all(isinstance(p, int) and
+                        not isinstance(p, bool) for p in pids):
+            mod_cli.fatal(DNError(
+                'bad "partitions" in query_partial request'))
+        # a router running a different topology file must never merge
+        # this member's partitions: the epoch gate accepts the
+        # committed epoch (and the pending epoch during a handoff
+        # window, once this member's shards for the partitions have
+        # landed) and rejects anything else with a clean retryable
+        # error carrying our current epoch — the stale side resyncs
+        serving = self._serving_for_epoch(req.get('epoch'),
+                                          pids=pids)
+        known = set(serving.partition_ids())
+        if not all(p in known for p in pids):
             mod_cli.fatal(DNError(
                 'bad "partitions" in query_partial request'))
         query = mod_cli.dn_query_config(opts)
@@ -1114,7 +1424,7 @@ class DnServer(object):
                         obs_trace.span('serve.execute',
                                        op='query_partial'):
                     return mod_router.partial_query(
-                        ds, query, interval, self.cluster, pids)
+                        ds, query, interval, serving, pids)
             finally:
                 slot.release()
 
@@ -1128,10 +1438,76 @@ class DnServer(object):
         except DNError as e:
             mod_cli.fatal(e)
         flags['coalesced'] = shared
-        body = json.dumps({'epoch': self.cluster.epoch,
+        body = json.dumps({'epoch': serving.epoch,
                            'member': self.member, 'shards': shards},
                           sort_keys=True, separators=(',', ':'))
         sys.stdout.write(body + '\n')
+        return 0
+
+    def _run_shard_manifest(self, req, ds, dsname, flags):
+        """The donor side of partition handoff: enumerate this
+        member's shards for the requested COMMITTED partitions as
+        (relpath, size, crc32) triples (serve/rebalance.py).  Control
+        plane: no admission slot (a handoff must not starve behind a
+        query flood), but the tree read lock holds so a concurrent
+        build cannot reshape the tree mid-enumeration."""
+        from . import rebalance as mod_rebalance
+        if self.cluster is None:
+            mod_cli.fatal(DNError(
+                'not a cluster member (start with '
+                '--cluster/--member)'))
+        serving = self._serving_for_epoch(req.get('epoch'))
+        pids = req.get('partitions')
+        known = set(serving.partition_ids())
+        if not isinstance(pids, list) or not pids or \
+                not all(isinstance(p, int) and
+                        not isinstance(p, bool) and p in known
+                        for p in pids):
+            mod_cli.fatal(DNError(
+                'bad "partitions" in shard_manifest request'))
+        with self._tree_lock(ds, dsname).read(), \
+                obs_trace.span('serve.execute', op='shard_manifest'):
+            try:
+                shards = mod_rebalance.shard_manifest(ds, serving,
+                                                      pids)
+            except DNError as e:
+                mod_cli.fatal(e)
+        body = json.dumps({'epoch': serving.epoch,
+                           'member': self.member, 'shards': shards},
+                          sort_keys=True, separators=(',', ':'))
+        sys.stdout.write(body + '\n')
+        return 0
+
+    def _run_shard_fetch(self, req, ds, dsname, flags):
+        """The donor side of one shard's stream: the raw shard bytes
+        as the response payload (the joiner verifies size + crc
+        against the manifest before landing them)."""
+        from . import rebalance as mod_rebalance
+        if self.cluster is None:
+            mod_cli.fatal(DNError(
+                'not a cluster member (start with '
+                '--cluster/--member)'))
+        self._serving_for_epoch(req.get('epoch'))
+        offset = req.get('offset') or 0
+        length = req.get('length')
+        if not isinstance(offset, int) or isinstance(offset, bool) \
+                or offset < 0 or \
+                (length is not None and
+                 (not isinstance(length, int) or
+                  isinstance(length, bool) or length < 1)):
+            mod_cli.fatal(DNError(
+                'bad "offset"/"length" in shard_fetch request'))
+        with self._tree_lock(ds, dsname).read(), \
+                obs_trace.span('serve.execute', op='shard_fetch'):
+            try:
+                data = mod_rebalance.read_shard(ds, req.get('rel'),
+                                                offset=offset,
+                                                length=length)
+            except DNError as e:
+                mod_cli.fatal(e)
+        # raw bytes, not text: write through the capture's underlying
+        # binary buffer (this handler writes nothing else)
+        sys.stdout.buffer.write(data)
         return 0
 
     def _local_partial(self, partition_ids, partial_req):
@@ -1142,8 +1518,17 @@ class DnServer(object):
         queue would deadlock the scatter)."""
         from .. import datasource_for_name
         from . import router as mod_router
-        backend = mod_config.ConfigBackendLocal(
-            partial_req.get('config') or None)
+        # same epoch + handoff gate as the socket path: the scatter
+        # snapshot may be one epoch behind (or ahead of) a cutover
+        # that landed between snapshot and execution — serving the
+        # wrong map locally would mix epochs in the merge
+        serving = self._serving_for_epoch(partial_req.get('epoch'),
+                                          pids=partition_ids)
+        cfg_path = partial_req.get('config') or None
+        override = serving.member_config(self.member)
+        if override:
+            cfg_path = override
+        backend = mod_config.ConfigBackendLocal(cfg_path)
         err, config = backend.load()
         if err is not None and not getattr(err, 'is_enoent', False):
             raise err
@@ -1162,7 +1547,7 @@ class DnServer(object):
         try:
             with self._tree_lock(ds, dsname).read():
                 return mod_router.partial_query(
-                    ds, query, interval, self.cluster, partition_ids)
+                    ds, query, interval, serving, partition_ids)
         finally:
             slot.release()
 
@@ -1239,15 +1624,19 @@ def sweep_configured_trees(warn=None):
 
 
 def serve_main(socket_path=None, port=None, pidfile=None,
-               cluster=None, member=None, router_conf=None):
+               cluster=None, member=None, router_conf=None,
+               pending=None, topo_conf=None):
     """Run the daemon until SIGTERM/SIGINT, then drain.  Returns the
     process exit code.  `cluster` (an already-loaded, validated
     topology.Topology) and `member` (this server's member name) start
     the scatter-gather cluster mode (serve/topology.py,
-    serve/router.py).  The CLI loads and validates the topology file
-    and DN_ROUTER_* knobs exactly once and hands the results here —
-    re-reading them would open a window where the state just
-    validated/printed differs from the state actually served."""
+    serve/router.py); `pending` is the in-flight transition epoch
+    when the topology file was mid-handoff at startup (a fresh joiner
+    starts pulling immediately).  The CLI loads and validates the
+    topology file and DN_ROUTER_*/DN_TOPO_* knobs exactly once and
+    hands the results here — re-reading them would open a window
+    where the state just validated/printed differs from the state
+    actually served."""
     conf = mod_config.serve_config()
     if isinstance(conf, DNError):
         raise conf
@@ -1262,7 +1651,8 @@ def serve_main(socket_path=None, port=None, pidfile=None,
                         pidfile=pidfile, warn=warn)
     server = DnServer(socket_path=socket_path, port=port,
                       pidfile=pidfile, conf=conf, cluster=topo,
-                      member=member, router_conf=router_conf)
+                      member=member, router_conf=router_conf,
+                      pending=pending, topo_conf=topo_conf)
     try:
         server.bind()
     except OSError as e:

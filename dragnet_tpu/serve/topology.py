@@ -42,6 +42,22 @@ File format (JSON):
   the rest.
 * ``members[].endpoint`` — a unix socket path or HOST:PORT, exactly
   the `--remote` address forms (serve/client.parse_addr).
+* ``members[].config`` (optional) — a per-member dragnetrc path.  When
+  set, THAT member resolves datasources for partial queries and shard
+  handoff through its own config instead of the request's, which lets
+  each member own a private index tree (the shard-streaming handoff
+  fills it).  Omitted in shared-filesystem deployments: every member
+  then walks the request's tree exactly as PR 8 did.
+
+Dynamic topology (serve/coordinator.py): the same file doubles as the
+coordinator source.  A topology may carry ``"state": "pending"`` plus
+a ``"prev"`` field embedding the last COMMITTED document; members
+polling the file (DN_TOPO_POLL_MS) then serve from ``prev`` while the
+new epoch's handoff runs, and cut over atomically when the file is
+rewritten as committed (state dropped, prev dropped).
+load_topology_state() returns both views; load_topology() keeps the
+static single-topology contract (a pending file reads as its
+committed ``prev``).
 
 Validation is strict and centralized here (load_topology raises the
 shared DNError contract; `dn serve --validate` reports it before any
@@ -59,6 +75,7 @@ from ..errors import DNError
 from .. import jsvalues as jsv
 
 ASSIGN_MODES = ('hash', 'time-range')
+STATES = ('committed', 'pending')
 
 
 class Topology(object):
@@ -68,11 +85,16 @@ class Topology(object):
         self.path = path
         self.epoch = doc['epoch']
         self.assign = doc.get('assign') or 'hash'
+        self.state = doc.get('state') or 'committed'
+        # free-form transition annotation (e.g. the rebalance
+        # planner's decisions); surfaced in /stats, never validated
+        self.note = doc.get('note')
         self.members = {name: dict(m)
                         for name, m in doc['members'].items()}
         parts = sorted(doc['partitions'], key=lambda p: p['id'])
         self.partitions = [
             {'id': p['id'], 'replicas': list(p['replicas']),
+             'after': p.get('after'), 'before': p.get('before'),
              'after_ms': p.get('_after_ms'),
              'before_ms': p.get('_before_ms')}
             for p in parts]
@@ -120,6 +142,13 @@ class Topology(object):
                         return p['id']
         return self._hash_partition(name)
 
+    def member_config(self, member):
+        """The member's own dragnetrc path when the topology declares
+        one (per-member index trees), else None (shared tree: the
+        request's config governs, the PR 8 contract)."""
+        m = self.members.get(member)
+        return m.get('config') if m else None
+
     def summary(self):
         """The /stats and --validate view."""
         return {
@@ -131,6 +160,27 @@ class Topology(object):
             'partitions': [{'id': p['id'],
                             'replicas': list(p['replicas'])}
                            for p in self.partitions],
+        }
+
+    def doc(self):
+        """Re-serialize as a canonical COMMITTED topology document
+        (what publish_topology writes; `state`/`prev` never survive a
+        round trip — transition framing is the coordinator's job)."""
+        partitions = []
+        for p in self.partitions:
+            ent = {'id': p['id'], 'replicas': list(p['replicas'])}
+            if p.get('after') is not None:
+                ent['after'] = p['after']
+            if p.get('before') is not None:
+                ent['before'] = p['before']
+            partitions.append(ent)
+        return {
+            'epoch': self.epoch,
+            'assign': self.assign,
+            'members': {name: {k: v for k, v in m.items()
+                               if k in ('endpoint', 'config')}
+                        for name, m in self.members.items()},
+            'partitions': partitions,
         }
 
 
@@ -149,16 +199,38 @@ def _parse_bound(p, key, pid):
                   % (pid, key, raw))
 
 
-def validate_doc(doc):
+def validate_doc(doc, _nested=False):
     """First violation of the topology document shape as a string, or
     None; on success the partitions gain parsed _after_ms/_before_ms
-    fields (time-range mode)."""
+    fields (time-range mode).  Transition framing: "state" must be
+    'committed' or 'pending'; a pending document must embed its last
+    committed predecessor as "prev" (itself a valid committed doc with
+    a strictly smaller epoch)."""
     if not isinstance(doc, dict):
         return 'topology is not an object'
     epoch = doc.get('epoch')
     if not isinstance(epoch, int) or isinstance(epoch, bool) or \
             epoch < 1:
         return '"epoch" must be an integer >= 1'
+    state = doc.get('state', 'committed')
+    if state not in STATES:
+        return '"state" must be one of: %s' % ', '.join(STATES)
+    prev = doc.get('prev')
+    if _nested and (state != 'committed' or prev is not None):
+        return '"prev" must be a committed topology without its own ' \
+            '"prev"'
+    if state == 'pending':
+        if prev is None:
+            return 'a pending topology must embed its committed ' \
+                'predecessor as "prev"'
+        err = validate_doc(prev, _nested=True)
+        if err is not None:
+            return 'prev: %s' % err
+        if prev['epoch'] >= epoch:
+            return 'pending epoch %d must exceed committed epoch %d' \
+                % (epoch, prev['epoch'])
+    elif prev is not None:
+        return '"prev" is only valid with "state": "pending"'
     assign = doc.get('assign', 'hash')
     if assign not in ASSIGN_MODES:
         return '"assign" must be one of: %s' % ', '.join(ASSIGN_MODES)
@@ -171,6 +243,10 @@ def validate_doc(doc):
                 not m['endpoint']:
             return 'member "%s": "endpoint" must be a non-empty ' \
                 'string' % name
+        if 'config' in m and (not isinstance(m['config'], str) or
+                              not m['config']):
+            return 'member "%s": "config" must be a non-empty ' \
+                'string when present' % name
     parts = doc.get('partitions')
     if not isinstance(parts, list) or not parts:
         return '"partitions" must be a non-empty array'
@@ -230,9 +306,12 @@ def validate_doc(doc):
     return None
 
 
-def load_topology(path, member=None):
-    """Load + validate a topology file; raises DNError on any
-    violation (including `member` not naming a member when given)."""
+def load_topology_state(path, member=None):
+    """Load + validate a topology file as (committed, pending):
+    (Topology, None) for a committed file, (Topology-of-prev,
+    Topology-of-new-epoch) for a pending transition file.  Raises
+    DNError on any violation, including `member` naming neither a
+    committed nor a pending member."""
     try:
         with open(path, 'r') as f:
             raw = f.read()
@@ -247,10 +326,28 @@ def load_topology(path, member=None):
     err = validate_doc(doc)
     if err is not None:
         raise DNError('cluster topology "%s": %s' % (path, err))
-    topo = Topology(doc, path=path)
-    if member is not None and member not in topo.members:
+    if doc.get('state') == 'pending':
+        committed = Topology(doc['prev'], path=path)
+        pending = Topology(doc, path=path)
+    else:
+        committed = Topology(doc, path=path)
+        pending = None
+    if member is not None and member not in committed.members and \
+            (pending is None or member not in pending.members):
+        have = set(committed.member_names())
+        if pending is not None:
+            have |= set(pending.member_names())
         raise DNError('cluster topology "%s": --member "%s" is not a '
                       'member (have: %s)'
-                      % (path, member,
-                         ', '.join(topo.member_names())))
-    return topo
+                      % (path, member, ', '.join(sorted(have))))
+    return committed, pending
+
+
+def load_topology(path, member=None):
+    """Load + validate a topology file; raises DNError on any
+    violation (including `member` not naming a member when given).
+    A pending transition file reads as its COMMITTED predecessor —
+    static consumers (execution plans, `dn serve` startup) serve the
+    last committed map until the transition commits."""
+    committed, _pending = load_topology_state(path, member=member)
+    return committed

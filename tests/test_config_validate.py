@@ -292,6 +292,33 @@ def test_router_config_rejects_bad_values():
         assert str(err).startswith(list(env)[0]), env
 
 
+def test_topo_config_defaults():
+    conf = mod_config.topo_config(env={})
+    assert conf == {'poll_ms': 0, 'handoff_timeout_s': 120,
+                    'handoff_retries': 2, 'max_moves': 2}
+
+
+def test_topo_config_parses_overrides():
+    conf = mod_config.topo_config(env={
+        'DN_TOPO_POLL_MS': '250',
+        'DN_TOPO_HANDOFF_TIMEOUT_S': '30',
+        'DN_TOPO_HANDOFF_RETRIES': '0',
+        'DN_TOPO_MAX_MOVES': '5'})
+    assert conf == {'poll_ms': 250, 'handoff_timeout_s': 30,
+                    'handoff_retries': 0, 'max_moves': 5}
+
+
+def test_topo_config_rejects_bad_values():
+    for env in ({'DN_TOPO_POLL_MS': 'x'},
+                {'DN_TOPO_POLL_MS': '-1'},
+                {'DN_TOPO_HANDOFF_TIMEOUT_S': '0'},
+                {'DN_TOPO_HANDOFF_RETRIES': '-1'},
+                {'DN_TOPO_MAX_MOVES': '0'}):
+        err = mod_config.topo_config(env=env)
+        assert isinstance(err, DNError), env
+        assert str(err).startswith(list(env)[0]), env
+
+
 def test_follow_config_defaults():
     conf = mod_config.follow_config(env={})
     assert conf == {'latency_ms': 500, 'max_bytes': 4 << 20,

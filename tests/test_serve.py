@@ -814,7 +814,10 @@ def test_serve_validate_ok(monkeypatch):
                    b'buckets=14\n'
                    b'router config ok: probe_ms=500 failures=3 '
                    b'cooldown_ms=2000 hedge_ms=0 fetch_timeout_s=60 '
-                   b'partial=error\n')
+                   b'partial=error\n'
+                   b'topo config ok: poll_ms=0 '
+                   b'handoff_timeout_s=120 handoff_retries=2 '
+                   b'max_moves=2\n')
 
 
 def test_serve_validate_reports_armed_faults(monkeypatch):

@@ -540,7 +540,8 @@ def test_hedge_fires_and_accounts_win(tmp_path, monkeypatch):
         return [['fast', []]]
 
     monkeypatch.setattr(router, '_fetch_one', fake_fetch)
-    shards = router._fetch_partition(0, {'partitions': [0]}, None)
+    shards = router._fetch_partition(0, {'partitions': [0]}, None,
+                                     router.topo)
     release.set()
     assert shards == [['fast', []]]
     with router._lock:
@@ -567,7 +568,8 @@ def test_hedge_wasted_when_primary_wins(tmp_path, monkeypatch):
         return [['hedge', []]]
 
     monkeypatch.setattr(router, '_fetch_one', fake_fetch)
-    shards = router._fetch_partition(0, {'partitions': [0]}, None)
+    shards = router._fetch_partition(0, {'partitions': [0]}, None,
+                                     router.topo)
     release_hedge.set()
     assert shards == [['primary', []]]
     with router._lock:
@@ -590,7 +592,8 @@ def test_failover_exhaustion_is_clean_error(tmp_path, monkeypatch):
 
     monkeypatch.setattr(router, '_fetch_one', fake_fetch)
     with pytest.raises(DNError) as ei:
-        router._fetch_partition(0, {'partitions': [0]}, None)
+        router._fetch_partition(0, {'partitions': [0]}, None,
+                                router.topo)
     assert 'all replicas failed' in ei.value.message
     assert 'tried a,b' in ei.value.message
     with router._lock:
@@ -644,7 +647,7 @@ def test_merge_rejects_duplicate_shard(tmp_path, monkeypatch,
          'before_ms': None})
     router.topo._by_id[1] = router.topo.partitions[1]
 
-    def fake_fetch_partition(pid, req, scope):
+    def fake_fetch_partition(pid, req, scope, topo):
         return [['2014-01-01.sqlite', [[['host0'], 3]]]]
 
     monkeypatch.setattr(router, '_fetch_partition',

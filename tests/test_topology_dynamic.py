@@ -1,0 +1,852 @@
+"""Dynamic topology: live membership, zero-downtime partition
+handoff, and elastic rebalancing (serve/coordinator.py,
+serve/rebalance.py, the router/server epoch machinery).
+
+Covers: pending/committed transition-document validation and the
+publish/begin/commit/abort lifecycle; the rebalance planner's
+deterministic proposals; a LIVE epoch bump on a serving cluster
+(member added, member removed — with the removed member's prober
+stopped and pooled connection evicted, the satellite leaks); real
+shard streaming between per-member index trees (a joiner starting
+EMPTY serves byte-identical results after handoff + commit);
+mid-handoff queries answered byte-identically at the committed epoch
+while pending-epoch partials for still-streaming partitions are
+rejected retryably; the stale-router resync contract (epoch mismatch
+-> re-fetch the map -> retry, byte-identical); handoff fault seams;
+and the `dn topo` CLI lifecycle."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dragnet_tpu import cli                                # noqa: E402
+from dragnet_tpu import faults as mod_faults               # noqa: E402
+from dragnet_tpu.errors import DNError                     # noqa: E402
+from dragnet_tpu.serve import client as mod_client         # noqa: E402
+from dragnet_tpu.serve import coordinator as mod_coord     # noqa: E402
+from dragnet_tpu.serve import pool as mod_pool             # noqa: E402
+from dragnet_tpu.serve import rebalance as mod_rebalance   # noqa: E402
+from dragnet_tpu.serve import server as mod_server         # noqa: E402
+from dragnet_tpu.serve import topology as mod_topology     # noqa: E402
+
+
+def run_cli(args):
+    with mod_server.thread_stdio() as cap:
+        rc = cli.main(list(args))
+    out, err = cap.finish()
+    return rc, out, err
+
+
+def _gen_corpus(path, n=400):
+    import datetime
+    t0 = 1388534400  # 2014-01-01T00:00:00Z
+    with open(path, 'w') as f:
+        for i in range(n):
+            ts = datetime.datetime.utcfromtimestamp(
+                t0 + i * 800).strftime('%Y-%m-%dT%H:%M:%S.000Z')
+            f.write(json.dumps({
+                'time': ts,
+                'host': 'host%d' % (i % 3),
+                'operation': ('get', 'put', 'index')[i % 3],
+                'latency': (i * 7) % 230,
+            }, separators=(',', ':')) + '\n')
+
+
+@pytest.fixture(scope='module')
+def corpus(tmp_path_factory):
+    """One datasource over a shared index tree (dnc format), built
+    once."""
+    root = tmp_path_factory.mktemp('topo_corpus')
+    datafile = str(root / 'data.log')
+    _gen_corpus(datafile)
+    rc_path = str(root / 'dragnetrc.json')
+    prior = os.environ.get('DRAGNET_CONFIG')
+    prior_fmt = os.environ.get('DN_INDEX_FORMAT')
+    os.environ['DRAGNET_CONFIG'] = rc_path
+    os.environ['DN_INDEX_FORMAT'] = 'dnc'
+    try:
+        idx = str(root / 'idx')
+        rc, out, err = run_cli([
+            'datasource-add', '--path', datafile,
+            '--index-path', idx, '--time-field', 'time', 'ds'])
+        assert rc == 0, err
+        rc, out, err = run_cli([
+            'metric-add', '-b', 'host,latency[aggr=quantize]',
+            'ds', 'm1'])
+        assert rc == 0, err
+        rc, out, err = run_cli(['build', 'ds'])
+        assert rc == 0, err
+        yield {'root': root, 'rc_path': rc_path, 'idx': idx,
+               'datafile': datafile}
+    finally:
+        if prior is None:
+            os.environ.pop('DRAGNET_CONFIG', None)
+        else:
+            os.environ['DRAGNET_CONFIG'] = prior
+        if prior_fmt is None:
+            os.environ.pop('DN_INDEX_FORMAT', None)
+        else:
+            os.environ['DN_INDEX_FORMAT'] = prior_fmt
+
+
+def _conf(**over):
+    base = {'max_inflight': 4, 'queue_depth': 16, 'deadline_ms': 0,
+            'coalesce': True, 'drain_s': 10}
+    base.update(over)
+    return base
+
+
+QUERY = ['query', '-b', 'host', 'ds']
+
+
+def _golden(corpus):
+    rc, out, err = run_cli(QUERY)
+    assert rc == 0, err
+    return out
+
+
+def _topo_doc(socks, epoch=1, parts=None):
+    if parts is None:
+        names = sorted(socks)
+        parts = [{'id': i, 'replicas':
+                  [names[i % len(names)],
+                   names[(i + 1) % len(names)]]}
+                 for i in range(3)]
+    return {'epoch': epoch, 'assign': 'hash',
+            'members': {m: {'endpoint': socks[m]} for m in socks},
+            'partitions': parts}
+
+
+# -- transition-document validation -----------------------------------------
+
+def test_pending_doc_validation(tmp_path):
+    socks = {m: str(tmp_path / (m + '.sock')) for m in 'ab'}
+    base = _topo_doc(socks)
+    # pending without prev
+    doc = dict(base, epoch=2, state='pending')
+    assert 'prev' in mod_topology.validate_doc(doc)
+    # pending epoch must exceed prev epoch
+    doc = dict(_topo_doc(socks, epoch=1), state='pending',
+               prev=_topo_doc(socks, epoch=1))
+    assert 'exceed' in mod_topology.validate_doc(doc)
+    # prev must itself be committed and prev-less
+    doc = dict(_topo_doc(socks, epoch=3), state='pending',
+               prev=dict(_topo_doc(socks, epoch=2), state='pending',
+                         prev=_topo_doc(socks, epoch=1)))
+    assert 'prev' in mod_topology.validate_doc(doc)
+    # bad state
+    assert 'state' in mod_topology.validate_doc(
+        dict(base, state='limbo'))
+    # committed docs must not carry prev
+    assert 'prev' in mod_topology.validate_doc(
+        dict(base, prev=_topo_doc(socks)))
+    # member config must be a non-empty string when present
+    bad = _topo_doc(socks)
+    bad['members']['a']['config'] = ''
+    assert 'config' in mod_topology.validate_doc(bad)
+    # a good pending doc validates
+    good = dict(_topo_doc(socks, epoch=2), state='pending',
+                prev=_topo_doc(socks, epoch=1))
+    assert mod_topology.validate_doc(good) is None
+
+
+def test_doc_roundtrip_and_state_load(tmp_path):
+    socks = {m: str(tmp_path / (m + '.sock')) for m in 'ab'}
+    doc = _topo_doc(socks)
+    doc['partitions'][0]['replicas'] = ['a']
+    topo = mod_topology.Topology(
+        json.loads(json.dumps(doc)))
+    assert topo.doc()['partitions'][0]['replicas'] == ['a']
+    path = str(tmp_path / 'topo.json')
+    mod_coord.publish_topology(path, doc)
+    committed, pending = mod_topology.load_topology_state(path)
+    assert committed.epoch == 1 and pending is None
+    # the canonical round trip preserves the map
+    assert committed.doc()['members'] == doc['members']
+
+
+def test_transition_lifecycle(tmp_path):
+    socks = {m: str(tmp_path / (m + '.sock')) for m in 'ab'}
+    path = str(tmp_path / 'topo.json')
+    mod_coord.publish_topology(path, _topo_doc(socks))
+    new = _topo_doc(socks)      # epoch auto-bumps to 2
+    del new['epoch']
+    committed, pending = mod_coord.begin_transition(path, new)
+    assert committed.epoch == 1 and pending.epoch == 2
+    assert pending.state == 'pending'
+    # a second transition is refused while one is pending
+    with pytest.raises(DNError) as ei:
+        mod_coord.begin_transition(path, _topo_doc(socks, epoch=9))
+    assert 'already pending' in ei.value.message
+    # load_topology (the static view) reads the committed prev
+    assert mod_topology.load_topology(path).epoch == 1
+    # abort restores committed
+    assert mod_coord.abort_transition(path).epoch == 1
+    c2, p2 = mod_topology.load_topology_state(path)
+    assert c2.epoch == 1 and p2 is None
+    # begin again, then commit
+    mod_coord.begin_transition(path, new)
+    assert mod_coord.commit_transition(path).epoch == 2
+    c3, p3 = mod_topology.load_topology_state(path)
+    assert c3.epoch == 2 and p3 is None
+    with pytest.raises(DNError):
+        mod_coord.commit_transition(path)    # nothing pending
+
+
+# -- rebalance planner ------------------------------------------------------
+
+def test_propose_moves_deterministic(tmp_path):
+    socks = {m: str(tmp_path / (m + '.sock')) for m in 'abc'}
+    doc = _topo_doc(socks, parts=[
+        {'id': 0, 'replicas': ['a', 'b']},
+        {'id': 1, 'replicas': ['a', 'c']},
+        {'id': 2, 'replicas': ['a', 'b']},
+    ])
+    topo = mod_topology.Topology(json.loads(json.dumps(doc)))
+    loads = {'a': 100.0, 'b': 10.0, 'c': 50.0}
+    new_doc, decisions = mod_rebalance.propose_moves(
+        topo, loads, max_moves=1)
+    assert new_doc['epoch'] == 2
+    assert len(decisions) == 1
+    d = decisions[0]
+    # the hottest member's lowest-id primary moves to the coldest
+    assert d['from'] == 'a' and d['to'] == 'b' and \
+        d['partition'] == 1    # partition 0 already replicates b
+    moved = [p for p in new_doc['partitions'] if p['id'] == 1][0]
+    assert moved['replicas'] == ['b', 'c']
+    # balanced loads propose nothing
+    none_doc, none_dec = mod_rebalance.propose_moves(
+        topo, {'a': 10.0, 'b': 9.0, 'c': 11.0})
+    assert none_doc is None and none_dec == []
+    # unreachable members (None) disable planning toward them
+    one, dec = mod_rebalance.propose_moves(
+        topo, {'a': 100.0, 'b': None, 'c': 1.0}, max_moves=1)
+    assert dec and dec[0]['to'] == 'c'
+
+
+# -- live epoch bump on a serving cluster (shared tree) ----------------------
+
+@pytest.fixture
+def cluster(corpus, tmp_path, monkeypatch):
+    """Three in-process members over the shared index tree, watcher
+    armed but slow-polling (tests drive poll_now() directly so
+    nothing races)."""
+    monkeypatch.setenv('DN_ROUTER_PROBE_MS', '60000')
+    monkeypatch.setenv('DN_REMOTE_RETRIES', '0')
+    monkeypatch.setenv('DN_REMOTE_BACKOFF_MS', '1')
+    monkeypatch.setenv('DN_REMOTE_CONNECT_TIMEOUT_S', '1')
+    monkeypatch.setenv('DN_TOPO_POLL_MS', '60000')
+    socks = {m: str(tmp_path / ('dn-%s.sock' % m)) for m in 'abc'}
+    topo_path = str(tmp_path / 'topo.json')
+    mod_coord.publish_topology(topo_path, _topo_doc(socks))
+    servers = {}
+    for m in 'abc':
+        topo = mod_topology.load_topology(topo_path, member=m)
+        servers[m] = mod_server.DnServer(
+            socket_path=socks[m], conf=_conf(), cluster=topo,
+            member=m).start()
+    try:
+        yield {'servers': servers, 'socks': socks,
+               'topo_path': topo_path, 'tmp': tmp_path}
+    finally:
+        for srv in servers.values():
+            srv.stop()
+        mod_pool.get().reset()
+
+
+def _poll_all(cluster, members=None):
+    for m, srv in cluster['servers'].items():
+        if members is not None and m not in members:
+            continue
+        if srv.topo_watcher is not None:
+            srv.topo_watcher.poll_now()
+
+
+def test_live_member_add_and_remove(cluster, corpus):
+    golden = _golden(corpus)
+    socks = dict(cluster['socks'])
+    topo_path = cluster['topo_path']
+    # routed golden at epoch 1
+    rc, out, err = run_cli(QUERY[:1] + ['--remote', socks['a']] +
+                           QUERY[1:])
+    assert rc == 0 and out == golden
+
+    # epoch 2: member d joins and takes over partition 2
+    socks['d'] = str(cluster['tmp'] / 'dn-d.sock')
+    new = _topo_doc(socks, parts=[
+        {'id': 0, 'replicas': ['a', 'b']},
+        {'id': 1, 'replicas': ['b', 'c']},
+        {'id': 2, 'replicas': ['d', 'a']},
+    ])
+    del new['epoch']       # auto-bumps to committed + 1
+    committed, pending = mod_coord.begin_transition(topo_path, new)
+    assert pending.epoch == 2
+    _poll_all(cluster)     # a/b/c observe the pending epoch
+    topo_d, pend_d = mod_topology.load_topology_state(topo_path,
+                                                      member='d')
+    srv_d = mod_server.DnServer(
+        socket_path=socks['d'], conf=_conf(), cluster=topo_d,
+        member='d', pending=pend_d).start()
+    cluster['servers']['d'] = srv_d
+    try:
+        # the joiner's handoff over a SHARED tree streams nothing:
+        # every shard is already present byte-identical
+        assert srv_d.puller is not None
+        assert srv_d.puller.wait(20)
+        assert srv_d.puller.ready
+        assert srv_d.puller.counters['shards_streamed'] == 0
+        status = mod_coord.wait_ready(topo_path, timeout_s=20)
+        assert status['ready'], status
+        mod_coord.commit_transition(topo_path)
+        _poll_all(cluster)
+        for m in 'abcd':
+            assert cluster['servers'][m].cluster.epoch == 2
+        # routed queries via old and new members: byte-identical
+        for via in ('a', 'd'):
+            rc, out, err = run_cli(
+                QUERY[:1] + ['--remote', socks[via]] + QUERY[1:])
+            assert rc == 0, err
+            assert out == golden
+        # /stats topology section reports the new epoch
+        doc = mod_client.stats(socks['a'], timeout_s=10.0)
+        assert doc['topology']['epoch'] == 2
+        assert doc['topology']['state'] == 'committed'
+        assert doc['cluster']['epoch'] == 2
+
+        # epoch 3: member c leaves (its partitions fall back to the
+        # others); its prober stops and its pooled conn evicts
+        router_a = cluster['servers']['a'].router
+        st_c = router_a.states['c']
+        evicted_before = mod_pool.get().counters.get('evicted', 0)
+        del socks['c']
+        newer = _topo_doc(socks, parts=[
+            {'id': 0, 'replicas': ['a', 'b']},
+            {'id': 1, 'replicas': ['b', 'd']},
+            {'id': 2, 'replicas': ['d', 'a']},
+        ])
+        del newer['epoch']
+        mod_coord.begin_transition(topo_path, newer)
+        _poll_all(cluster)
+        # during the pending window the leaving member reports
+        # draining (demoted, not dead)
+        h = mod_client.health(cluster['socks']['c'], timeout_s=5.0)
+        assert h['ok'] and h['draining']
+        status = mod_coord.wait_ready(topo_path, timeout_s=20)
+        assert status['ready'], status
+        mod_coord.commit_transition(topo_path)
+        _poll_all(cluster)
+        assert 'c' not in router_a.states
+        assert st_c.gone.is_set()
+        assert mod_pool.get().counters.get('evicted', 0) > \
+            evicted_before
+        rc, out, err = run_cli(
+            QUERY[:1] + ['--remote', socks['a']] + QUERY[1:])
+        assert rc == 0 and out == golden
+    finally:
+        srv_d.stop()
+
+
+def test_stale_router_resyncs_on_epoch_mismatch(cluster, corpus):
+    golden = _golden(corpus)
+    socks = cluster['socks']
+    topo_path = cluster['topo_path']
+    # bump the epoch (same shape) and let only b and c see the
+    # commit — a stays on epoch 1
+    new = _topo_doc(socks)
+    del new['epoch']
+    mod_coord.begin_transition(topo_path, new)
+    _poll_all(cluster)
+    status = mod_coord.wait_ready(topo_path, timeout_s=20)
+    assert status['ready'], status
+    mod_coord.commit_transition(topo_path)
+    _poll_all(cluster, members='bc')
+    assert cluster['servers']['b'].cluster.epoch == 2
+    assert cluster['servers']['a'].cluster.epoch == 1
+    # routing via the stale member a: members reject with the epoch
+    # mismatch, a resyncs (poll_now) and retries — byte-identical
+    rc, out, err = run_cli(QUERY[:1] + ['--remote', socks['a']] +
+                           QUERY[1:])
+    assert rc == 0, err
+    assert out == golden
+    srv_a = cluster['servers']['a']
+    assert srv_a.cluster.epoch == 2
+    assert srv_a._topo_counters['resyncs'] >= 1
+    mm = sum(s._topo_counters['mismatch_rejections']
+             for s in cluster['servers'].values())
+    assert mm >= 1
+
+
+def test_mismatch_rejection_names_current_epoch(cluster, corpus):
+    socks = cluster['socks']
+    req = {'op': 'query_partial', 'ds': 'ds',
+           'config': corpus['rc_path'],
+           'queryconfig': {'breakdowns': [
+               {'name': 'host', 'field': 'host'}]},
+           'interval': 'day', 'opts': {}, 'epoch': 99,
+           'partitions': [0]}
+    rc, header, out, err = mod_client.request_bytes(
+        socks['a'], req, timeout_s=10.0)
+    assert rc != 0
+    assert header['retryable']
+    assert header['stats']['epoch_mismatch']
+    assert header['stats']['current_epoch'] == \
+        cluster['servers']['a'].cluster.epoch
+    assert b'epoch mismatch' in err
+
+
+# -- real shard streaming between per-member trees ---------------------------
+
+def _write_member_rc(tmp_path, name, datafile, template_rc):
+    """A per-member dragnetrc: same datasource, private index
+    tree."""
+    with open(template_rc, 'r') as f:
+        doc = json.load(f)
+    idx = str(tmp_path / ('idx_%s' % name))
+    for ds in doc.get('datasources', []):
+        bc = ds.get('backend_config') or ds.get('ds_backend_config')
+        if bc and bc.get('indexPath'):
+            bc['indexPath'] = idx
+    path = str(tmp_path / ('rc_%s.json' % name))
+    with open(path, 'w') as f:
+        json.dump(doc, f)
+    return path, idx
+
+
+def test_handoff_streams_shards_to_empty_joiner(corpus, tmp_path,
+                                                monkeypatch):
+    monkeypatch.setenv('DN_ROUTER_PROBE_MS', '60000')
+    monkeypatch.setenv('DN_REMOTE_RETRIES', '0')
+    monkeypatch.setenv('DN_TOPO_POLL_MS', '60000')
+    # a tiny range-fetch chunk forces the multi-chunk assembly path
+    # (large shards must stream bounded, never buffer whole)
+    monkeypatch.setattr(mod_rebalance, 'FETCH_CHUNK_BYTES', 512)
+    golden = _golden(corpus)
+    socks = {m: str(tmp_path / ('dn-%s.sock' % m)) for m in 'ab'}
+    # member a serves the BUILT tree through its own config; member
+    # b starts with an EMPTY private tree
+    rc_a, idx_a = _write_member_rc(tmp_path, 'a',
+                                   corpus['datafile'],
+                                   corpus['rc_path'])
+    rc_b, idx_b = _write_member_rc(tmp_path, 'b',
+                                   corpus['datafile'],
+                                   corpus['rc_path'])
+    import shutil
+    shutil.copytree(corpus['idx'], idx_a)
+    topo_path = str(tmp_path / 'topo.json')
+    doc1 = {'epoch': 1, 'assign': 'hash',
+            'members': {'a': {'endpoint': socks['a'],
+                              'config': rc_a}},
+            'partitions': [{'id': 0, 'replicas': ['a']},
+                           {'id': 1, 'replicas': ['a']}]}
+    mod_coord.publish_topology(topo_path, doc1)
+    topo_a = mod_topology.load_topology(topo_path, member='a')
+    srv_a = mod_server.DnServer(
+        socket_path=socks['a'], conf=_conf(), cluster=topo_a,
+        member='a').start()
+    srv_b = None
+    try:
+        rc, out, err = run_cli(QUERY[:1] + ['--remote', socks['a']] +
+                               QUERY[1:])
+        assert rc == 0, err
+        assert out == golden
+        # epoch 2: b joins and takes partition 1 — its shards must
+        # STREAM from a into b's empty tree before commit
+        doc2 = {'assign': 'hash',
+                'members': {'a': {'endpoint': socks['a'],
+                                  'config': rc_a},
+                            'b': {'endpoint': socks['b'],
+                                  'config': rc_b}},
+                'partitions': [{'id': 0, 'replicas': ['a']},
+                               {'id': 1, 'replicas': ['b', 'a']}]}
+        mod_coord.begin_transition(topo_path, doc2)
+        srv_a.topo_watcher.poll_now()   # a observes the pending epoch
+        topo_b, pend_b = mod_topology.load_topology_state(
+            topo_path, member='b')
+        srv_b = mod_server.DnServer(
+            socket_path=socks['b'], conf=_conf(), cluster=topo_b,
+            member='b', pending=pend_b).start()
+        assert srv_b.puller is not None
+        assert srv_b.puller.wait(30)
+        assert srv_b.puller.ready, srv_b.puller.status()
+        streamed = srv_b.puller.counters
+        assert streamed['shards_streamed'] > 0
+        assert streamed['bytes_streamed'] > 0
+        # b's tree holds exactly its pending partition's shards,
+        # byte-identical to a's copies
+        import dragnet_tpu.index_journal as mod_journal
+        pend = mod_topology.load_topology_state(topo_path)[1]
+        got = []
+        for r, dirs, names in os.walk(idx_b):
+            dirs[:] = [d for d in dirs
+                       if not mod_journal.is_index_litter(d)]
+            for n in names:
+                if mod_journal.is_index_litter(n):
+                    continue
+                rel = os.path.relpath(os.path.join(r, n), idx_b)
+                got.append(rel)
+                with open(os.path.join(idx_b, rel), 'rb') as f:
+                    b_bytes = f.read()
+                with open(os.path.join(idx_a, rel), 'rb') as f:
+                    a_bytes = f.read()
+                assert b_bytes == a_bytes, rel
+        assert got
+        for rel in got:
+            assert pend.partition_of(
+                rel, '%Y-%m-%d.sqlite') == 1
+        # commit and verify byte-identity via BOTH members
+        status = mod_coord.wait_ready(topo_path, timeout_s=20)
+        assert status['ready'], status
+        mod_coord.commit_transition(topo_path)
+        srv_a.topo_watcher.poll_now()
+        srv_b.topo_watcher.poll_now()
+        assert srv_a.cluster.epoch == 2
+        assert srv_b.cluster.epoch == 2
+        for via in 'ab':
+            rc, out, err = run_cli(
+                QUERY[:1] + ['--remote', socks[via]] + QUERY[1:])
+            assert rc == 0, err
+            assert out == golden, 'via %s' % via
+        # handoff telemetry reached /stats
+        doc = mod_client.stats(socks['b'], timeout_s=10.0)
+        topo_sec = doc['topology']
+        assert topo_sec['epoch'] == 2
+        hand = topo_sec['handoff']
+        assert hand['counters']['shards_streamed'] > 0
+        mets = doc['metrics']['counters']
+        assert mets.get('handoff_shards_streamed_total', 0) > 0
+    finally:
+        srv_a.stop()
+        if srv_b is not None:
+            srv_b.stop()
+        mod_pool.get().reset()
+
+
+def test_mid_handoff_partials_gate(corpus, tmp_path, monkeypatch):
+    """While a joiner's shards are still streaming, a pending-epoch
+    partial for the moving partition is rejected retryably (never a
+    silent short shard set) and committed-epoch traffic is untouched
+    — a query mid-handoff is answered byte-identically by the
+    committed epoch."""
+    monkeypatch.setenv('DN_ROUTER_PROBE_MS', '60000')
+    monkeypatch.setenv('DN_REMOTE_RETRIES', '0')
+    golden = _golden(corpus)
+    socks = {m: str(tmp_path / ('dn-%s.sock' % m)) for m in 'ab'}
+    topo_path = str(tmp_path / 'topo.json')
+    doc1 = _topo_doc(socks, parts=[
+        {'id': 0, 'replicas': ['a']},
+        {'id': 1, 'replicas': ['a', 'b']},
+        {'id': 2, 'replicas': ['b', 'a']}])
+    mod_coord.publish_topology(topo_path, doc1)
+    servers = {}
+    for m in 'ab':
+        topo = mod_topology.load_topology(topo_path, member=m)
+        servers[m] = mod_server.DnServer(
+            socket_path=socks[m], conf=_conf(), cluster=topo,
+            member=m).start()
+    try:
+        doc2 = _topo_doc(socks, epoch=2, parts=[
+            {'id': 0, 'replicas': ['b', 'a']},   # 0 moves to b
+            {'id': 1, 'replicas': ['a', 'b']},
+            {'id': 2, 'replicas': ['b', 'a']}])
+        committed, pending = mod_coord.begin_transition(topo_path,
+                                                        doc2)
+        # simulate an in-flight pull on b: puller exists, not ready
+        srv_b = servers['b']
+        puller = mod_rebalance.HandoffPuller(
+            committed, pending, 'b')
+        puller.affected_pids = {0}
+        with srv_b._topo_lock:
+            srv_b.pending = pending
+            srv_b.puller = puller
+        req = {'op': 'query_partial', 'ds': 'ds',
+               'config': corpus['rc_path'],
+               'queryconfig': {'breakdowns': [
+                   {'name': 'host', 'field': 'host'}]},
+               'interval': 'day', 'opts': {}}
+        # pending-epoch partial for the moving partition: retryable
+        # handoff-incomplete rejection
+        rc, header, out, err = mod_client.request_bytes(
+            socks['b'], dict(req, epoch=2, partitions=[0]),
+            timeout_s=10.0)
+        assert rc != 0 and header['retryable']
+        assert b'handoff incomplete' in err
+        # pending-epoch partial for an UNAFFECTED partition serves
+        rc, header, out, err = mod_client.request_bytes(
+            socks['b'], dict(req, epoch=2, partitions=[2]),
+            timeout_s=10.0)
+        assert rc == 0, err
+        # committed-epoch partials serve as before
+        rc, header, out, err = mod_client.request_bytes(
+            socks['b'], dict(req, epoch=1, partitions=[2]),
+            timeout_s=10.0)
+        assert rc == 0, err
+        # a full routed query mid-handoff: byte-identical (runs at
+        # the committed epoch)
+        rc, out, err = run_cli(QUERY[:1] + ['--remote', socks['a']] +
+                               QUERY[1:])
+        assert rc == 0, err
+        assert out == golden
+        # once the puller is ready the pending epoch serves too
+        puller.ready = True
+        rc, header, out, err = mod_client.request_bytes(
+            socks['b'], dict(req, epoch=2, partitions=[0]),
+            timeout_s=10.0)
+        assert rc == 0, err
+    finally:
+        for srv in servers.values():
+            srv.stop()
+        mod_pool.get().reset()
+
+
+def test_reapplied_same_epoch_restarts_handoff(corpus, tmp_path,
+                                               monkeypatch):
+    """abort + re-apply reuses epoch committed+1: a member that only
+    sees the FINAL file must restart its handoff for the new map —
+    keeping the withdrawn map's completed pull would serve the new
+    assignments with the old shards (silently short)."""
+    monkeypatch.setenv('DN_ROUTER_PROBE_MS', '60000')
+    socks = {m: str(tmp_path / (m + '.sock')) for m in 'ab'}
+    topo_path = str(tmp_path / 'topo.json')
+    mod_coord.publish_topology(topo_path, _topo_doc(socks, parts=[
+        {'id': 0, 'replicas': ['a', 'b']},
+        {'id': 1, 'replicas': ['b', 'a']}]))
+    topo = mod_topology.load_topology(topo_path, member='a')
+    srv = mod_server.DnServer(
+        socket_path=socks['a'], conf=_conf(), cluster=topo,
+        member='a').start()
+    try:
+        doc_a = _topo_doc(socks, epoch=2, parts=[
+            {'id': 0, 'replicas': ['a']},
+            {'id': 1, 'replicas': ['b', 'a']}])
+        pend_a = mod_topology.Topology(
+            json.loads(json.dumps(dict(
+                doc_a, state='pending',
+                prev=_topo_doc(socks, parts=doc_a['partitions'])))))
+        srv.apply_topology(srv.cluster, pend_a)
+        first = srv.puller
+        assert first is not None
+        # same epoch number, DIFFERENT map (the re-applied proposal)
+        doc_b = _topo_doc(socks, epoch=2, parts=[
+            {'id': 0, 'replicas': ['b', 'a']},
+            {'id': 1, 'replicas': ['a']}])
+        pend_b = mod_topology.Topology(
+            json.loads(json.dumps(dict(
+                doc_b, state='pending',
+                prev=_topo_doc(socks, parts=doc_a['partitions'])))))
+        srv.apply_topology(srv.cluster, pend_b)
+        assert srv.puller is not first
+        assert srv.pending.doc() == \
+            mod_topology.Topology(
+                json.loads(json.dumps(doc_b))).doc()
+        # an identical re-observation does NOT churn the puller
+        second = srv.puller
+        srv.apply_topology(srv.cluster, pend_b)
+        assert srv.puller is second
+    finally:
+        srv.stop()
+        mod_pool.get().reset()
+
+
+# -- handoff fault seams ----------------------------------------------------
+
+def test_handoff_fetch_faults_surface_as_failed_pull(
+        corpus, tmp_path, monkeypatch):
+    monkeypatch.setenv('DN_ROUTER_PROBE_MS', '60000')
+    monkeypatch.setenv('DN_REMOTE_RETRIES', '0')
+    socks = {'a': str(tmp_path / 'dn-a.sock'),
+             'b': str(tmp_path / 'dn-b.sock')}
+    rc_a, idx_a = _write_member_rc(tmp_path, 'a',
+                                   corpus['datafile'],
+                                   corpus['rc_path'])
+    rc_b, idx_b = _write_member_rc(tmp_path, 'b',
+                                   corpus['datafile'],
+                                   corpus['rc_path'])
+    import shutil
+    shutil.copytree(corpus['idx'], idx_a)
+    topo_path = str(tmp_path / 'topo.json')
+    doc1 = {'epoch': 1, 'assign': 'hash',
+            'members': {'a': {'endpoint': socks['a'],
+                              'config': rc_a}},
+            'partitions': [{'id': 0, 'replicas': ['a']}]}
+    mod_coord.publish_topology(topo_path, doc1)
+    topo_a = mod_topology.load_topology(topo_path, member='a')
+    srv_a = mod_server.DnServer(
+        socket_path=socks['a'], conf=_conf(), cluster=topo_a,
+        member='a').start()
+    try:
+        doc2 = {'epoch': 2, 'assign': 'hash',
+                'members': {'a': {'endpoint': socks['a'],
+                                  'config': rc_a},
+                            'b': {'endpoint': socks['b'],
+                                  'config': rc_b}},
+                'partitions': [{'id': 0, 'replicas': ['b', 'a']}]}
+        committed, pending = mod_coord.begin_transition(topo_path,
+                                                        doc2)
+        monkeypatch.setenv('DN_FAULTS', 'handoff.fetch:error:1.0')
+        monkeypatch.setenv('DN_TOPO_HANDOFF_RETRIES', '0')
+        mod_faults.reset()
+        puller = mod_rebalance.HandoffPuller(
+            committed, pending, 'b').start()
+        assert puller.wait(30)
+        assert not puller.ready
+        assert puller.failed
+        assert puller.counters['fetch_failures'] > 0
+        # no torn tmps: the recovery naming keeps the tree clean
+        assert not os.path.isdir(idx_b) or all(
+            False for _ in os.scandir(idx_b))
+        # a SERVING joiner whose pull failed is not wedged until a
+        # process restart: once the transient cause clears, the next
+        # topology poll retries the pull (watcher-driven)
+        monkeypatch.setenv('DN_TOPO_POLL_MS', '60000')
+        topo_b, pend_b = mod_topology.load_topology_state(
+            topo_path, member='b')
+        srv_b = mod_server.DnServer(
+            socket_path=socks['b'], conf=_conf(), cluster=topo_b,
+            member='b', pending=pend_b).start()
+        try:
+            assert srv_b.puller.wait(30)
+            assert srv_b.puller.failed
+            failed_puller = srv_b.puller
+            srv_b.topo_watcher.poll_now()     # seeds the file ident
+            # still failing: the retry restarts the pull, which
+            # fails again (fault still armed)
+            assert srv_b.topo_watcher.poll_now() is False
+            assert srv_b.puller is not failed_puller
+            assert srv_b.puller.wait(30)
+            assert srv_b.puller.failed
+            # cause clears -> the next poll's retry succeeds
+            monkeypatch.delenv('DN_FAULTS')
+            mod_faults.reset()
+            srv_b.topo_watcher.poll_now()
+            assert srv_b.puller.wait(30)
+            assert srv_b.puller.ready, srv_b.puller.status()
+            assert srv_b._topo_counters['handoff_retries'] >= 2
+        finally:
+            srv_b.stop()
+    finally:
+        srv_a.stop()
+        mod_pool.get().reset()
+
+
+# -- pool eviction unit -----------------------------------------------------
+
+def test_pool_close_endpoint_drops_conn_and_v1_memory():
+    pool = mod_pool.ConnectionPool()
+
+    class FakeConn(object):
+        broken = False
+        saw_v1 = False
+
+        def __init__(self):
+            self.failed = []
+
+        def _fail_all(self, err, from_reader=False):
+            self.broken = True
+            self.failed.append(str(err))
+
+    conn = FakeConn()
+    with pool._lock:
+        pool._check_pid()
+        pool._conns['ep1'] = conn
+        pool._v1.add('ep1')
+    assert pool.close_endpoint('ep1')
+    assert conn.broken and conn.failed
+    assert pool.counters['evicted'] == 1
+    assert not pool.is_v1('ep1')
+    assert pool.stats()['open'] == 0
+    # idempotent: a second close is a no-op
+    assert not pool.close_endpoint('ep1')
+
+
+# -- dn topo CLI ------------------------------------------------------------
+
+def test_cli_topo_lifecycle(tmp_path):
+    socks = {m: str(tmp_path / (m + '.sock')) for m in 'ab'}
+    path = str(tmp_path / 'topo.json')
+    mod_coord.publish_topology(path, _topo_doc(socks))
+    rc, out, err = run_cli(['topo', 'show', '--topology', path])
+    assert rc == 0
+    assert json.loads(out.decode())['committed']['epoch'] == 1
+    new_path = str(tmp_path / 'new.json')
+    new = _topo_doc(socks)
+    del new['epoch']
+    with open(new_path, 'w') as f:
+        json.dump(new, f)
+    rc, out, err = run_cli(['topo', 'apply', new_path,
+                            '--topology', path])
+    assert rc == 0, err
+    assert b'pending epoch 2' in err
+    # status: pending, members unreachable -> not ready (rc 1)
+    rc, out, err = run_cli(['topo', 'status', '--topology', path])
+    assert rc == 1
+    doc = json.loads(out.decode())
+    assert doc['pending_epoch'] == 2 and not doc['ready']
+    # commit refuses while not ready...
+    rc, out, err = run_cli(['topo', 'commit', '--topology', path])
+    assert rc != 0
+    assert b'not ready' in err
+    # ...and --force cuts over
+    rc, out, err = run_cli(['topo', 'commit', '--force',
+                            '--topology', path])
+    assert rc == 0, err
+    assert b'epoch 2 committed' in err
+    rc, out, err = run_cli(['topo', 'show', '--topology', path])
+    assert json.loads(out.decode())['committed']['epoch'] == 2
+    # abort with nothing pending is a clean error
+    rc, out, err = run_cli(['topo', 'abort', '--topology', path])
+    assert rc != 0 and b'dn:' in err
+
+
+def test_cli_topo_requires_topology_path(monkeypatch):
+    monkeypatch.delenv('DN_SERVE_TOPOLOGY', raising=False)
+    rc, out, err = run_cli(['topo', 'status'])
+    assert rc == 2
+
+
+# -- watcher robustness -----------------------------------------------------
+
+def test_watcher_survives_poll_faults(corpus, tmp_path, monkeypatch):
+    monkeypatch.setenv('DN_ROUTER_PROBE_MS', '60000')
+    monkeypatch.setenv('DN_TOPO_POLL_MS', '60000')
+    socks = {'a': str(tmp_path / 'dn-a.sock')}
+    topo_path = str(tmp_path / 'topo.json')
+    mod_coord.publish_topology(
+        topo_path,
+        {'epoch': 1, 'assign': 'hash',
+         'members': {'a': {'endpoint': socks['a']}},
+         'partitions': [{'id': 0, 'replicas': ['a']}]})
+    topo = mod_topology.load_topology(topo_path, member='a')
+    srv = mod_server.DnServer(
+        socket_path=socks['a'], conf=_conf(), cluster=topo,
+        member='a').start()
+    try:
+        watcher = srv.topo_watcher
+        assert watcher is not None
+        monkeypatch.setenv('DN_FAULTS', 'topo.poll:error:1.0')
+        mod_faults.reset()
+        assert watcher.poll_now() is False
+        assert watcher.counters['errors'] >= 1
+        assert srv.cluster.epoch == 1          # still serving
+        monkeypatch.delenv('DN_FAULTS')
+        mod_faults.reset()
+        # a malformed rewrite is also survived
+        with open(topo_path, 'w') as f:
+            f.write('{nope')
+        assert watcher.poll_now() is False
+        assert srv.cluster.epoch == 1
+        # and a good rewrite applies
+        mod_coord.publish_topology(
+            topo_path,
+            {'epoch': 2, 'assign': 'hash',
+             'members': {'a': {'endpoint': socks['a']}},
+             'partitions': [{'id': 0, 'replicas': ['a']}]})
+        assert watcher.poll_now() is True
+        assert srv.cluster.epoch == 2
+    finally:
+        srv.stop()
+        mod_pool.get().reset()

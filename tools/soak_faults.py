@@ -1031,6 +1031,413 @@ def soak_overload(root, fast=False, verbose=True, floor=None):
     return s.summary()
 
 
+# -- dynamic-topology (live resize) drill ------------------------------------
+
+# armed while the cluster resizes under flood: handoff fetch/manifest
+# failures (the joiner must retry/fail over), topology-poll failures
+# (a member must keep serving its last good map), plus transport
+# chaos on the routed path
+REBALANCE_SPEC = ('handoff.fetch:error:0.12:81,'
+                  'handoff.manifest:error:0.08:82,'
+                  'topo.poll:error:0.15:83,'
+                  'client.connect:error:0.03:84,'
+                  'serve.write:error:0.03:85')
+
+
+class RebalanceSoak(ClusterSoak):
+    """Live-resize drill: a serving cluster grows 3 -> 5 members and
+    shrinks 5 -> 2 under sustained routed-query flood with handoff/
+    topology faults armed, a joiner SIGKILLed mid-handoff (restarted,
+    re-pulls idempotently), and a donor SIGKILLed mid-flood.  The
+    joiners own PRIVATE index trees that start EMPTY — their shards
+    genuinely stream from the committed owners.  Contract: zero
+    byte-diffs vs the single-process goldens on every accepted
+    response, zero dropped partitions (full-query byte-identity
+    proves every partition served), zero hangs."""
+
+    POLL_MS = '150'
+
+    def __init__(self, ctx, verbose=True):
+        super(RebalanceSoak, self).__init__(ctx, verbose=verbose)
+        self.procs = {}          # subprocess members: name -> Popen
+        self.member_rc = {}      # per-member config paths (joiners)
+        self.flood_results = []
+        self.flood_stop = None
+        self.flood_threads = []
+
+    # -- lifecycle ----------------------------------------------------
+
+    def write_member_rc(self, name):
+        """A joiner's private config: the shared datasources
+        re-pointed at empty per-member index trees."""
+        with open(self.ctx['rc_path'], 'r') as f:
+            doc = json.load(f)
+        for ds in doc.get('datasources', []):
+            bc = ds.get('backend_config') or {}
+            if bc.get('indexPath'):
+                bc['indexPath'] = os.path.join(
+                    self.ctx['root'],
+                    'idx_%s_%s' % (ds['name'], name))
+        path = os.path.join(self.ctx['root'], 'rc_%s.json' % name)
+        with open(path, 'w') as f:
+            json.dump(doc, f)
+        self.member_rc[name] = path
+        return path
+
+    def start_cluster(self):
+        root = self.ctx['root']
+        self.socks = {m: os.path.join(root, 'dn-%s.sock' % m)
+                      for m in 'abcde'}
+        self.topo_path = os.path.join(root, 'topo.json')
+        from dragnet_tpu.serve import coordinator as mod_coord
+        mod_coord.publish_topology(self.topo_path, {
+            'epoch': 1, 'assign': 'hash',
+            'members': {m: {'endpoint': self.socks[m]}
+                        for m in 'abc'},
+            'partitions': [
+                {'id': 0, 'replicas': ['a', 'b']},
+                {'id': 1, 'replicas': ['b', 'c']},
+                {'id': 2, 'replicas': ['c', 'a']},
+            ],
+        })
+        from dragnet_tpu.serve import topology as mod_topology
+        conf = {'max_inflight': 8, 'queue_depth': 32,
+                'deadline_ms': 0, 'coalesce': True, 'drain_s': 10}
+        for m in 'ac':
+            topo = mod_topology.load_topology(self.topo_path,
+                                              member=m)
+            self.servers[m] = mod_server.DnServer(
+                socket_path=self.socks[m], conf=dict(conf),
+                cluster=topo, member=m).start()
+        self.spawn_member('b')
+
+    def spawn_member(self, name, extra_env=None):
+        if os.path.exists(self.socks[name]):
+            os.unlink(self.socks[name])
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        env.pop('DN_FAULTS', None)
+        env.update(extra_env or {})
+        self.procs[name] = subprocess.Popen(
+            [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+             'serve', '--socket', self.socks[name],
+             '--cluster', self.topo_path, '--member', name],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            doc = mod_client.health(self.socks[name], timeout_s=2.0)
+            if doc.get('ok'):
+                return
+            time.sleep(0.1)
+        raise RuntimeError('member %s never became healthy' % name)
+
+    def stop_cluster(self):
+        for srv in self.servers.values():
+            try:
+                srv.stop()
+            except Exception:
+                pass
+        self.servers = {}
+        for proc in self.procs.values():
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        self.procs = {}
+
+    # -- the flood ----------------------------------------------------
+
+    def start_flood(self, nthreads=3):
+        import threading
+        self.flood_stop = threading.Event()
+        self.flood_results = []
+        lock = threading.Lock()
+
+        def worker(tid):
+            i = 0
+            while not self.flood_stop.is_set():
+                fmt = FORMATS[(tid + i) % len(FORMATS)]
+                ds = self.ctx['ds'][fmt]
+                cases = query_cases(ds)
+                case = cases[(tid + i) % len(cases)]
+                i += 1
+                got = run_cli(case[:1] +
+                              ['--remote', self.socks['a']] +
+                              case[1:])
+                with lock:
+                    self.flood_results.append((fmt, case, got))
+
+        self.flood_threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(nthreads)]
+        for t in self.flood_threads:
+            t.start()
+
+    def stop_flood(self):
+        self.flood_stop.set()
+        for t in self.flood_threads:
+            t.join(120)
+            if t.is_alive():
+                self.violate('resize flood: query thread hung')
+        for fmt, case, got in self.flood_results:
+            self.check_routed(fmt, case, got)
+        self.note('flood: %d routed queries checked'
+                  % len(self.flood_results))
+        self.flood_threads = []
+
+    # -- epoch helpers ------------------------------------------------
+
+    def wait_epoch(self, names, epoch, timeout_s=30.0):
+        """Every named member reports `epoch` committed (the watcher
+        cadence propagates commits asynchronously)."""
+        deadline = time.time() + timeout_s
+        lag = list(names)
+        while time.time() < deadline and lag:
+            lag = []
+            for name in names:
+                try:
+                    doc = mod_client.stats(self.socks[name],
+                                           timeout_s=10.0)
+                    if (doc.get('topology') or {}).get('epoch') \
+                            != epoch:
+                        lag.append(name)
+                except Exception:
+                    lag.append(name)
+            if lag:
+                time.sleep(0.2)
+        if lag:
+            self.violate('members %s never reached epoch %d'
+                         % (','.join(lag), epoch))
+
+    def resize(self, new_doc, joiners=(), ready_timeout_s=90.0,
+               kill_joiner=None):
+        """One transition: publish pending, (optionally) SIGKILL a
+        subprocess joiner mid-handoff and restart it, wait for
+        readiness, commit."""
+        from dragnet_tpu.serve import coordinator as mod_coord
+        committed, pending = mod_coord.begin_transition(
+            self.topo_path, new_doc)
+        self.note('pending epoch %d published' % pending.epoch)
+        if kill_joiner is not None:
+            time.sleep(0.4)      # let its pull get in flight
+            proc = self.procs[kill_joiner]
+            proc.kill()
+            proc.wait()
+            self.note('SIGKILLed joiner %s mid-handoff'
+                      % kill_joiner)
+            # committed ownership is untouched: queries keep
+            # answering byte-identically while the joiner is down
+            ds = self.ctx['ds'][FORMATS[0]]
+            case = query_cases(ds)[0]
+            got = run_cli(case[:1] + ['--remote', self.socks['a']] +
+                          case[1:])
+            self.check_routed(FORMATS[0], case, got,
+                              degraded_ok=False)
+            self.spawn_member(kill_joiner)   # restart: re-pull
+            self.note('restarted joiner %s' % kill_joiner)
+        status = mod_coord.wait_ready(self.topo_path,
+                                      timeout_s=ready_timeout_s,
+                                      poll_s=0.25)
+        if not status.get('ready'):
+            self.violate('transition to epoch %d never became '
+                         'ready: %s'
+                         % (pending.epoch, json.dumps(status)))
+            return None
+        mod_coord.commit_transition(self.topo_path)
+        self.note('epoch %d committed' % pending.epoch)
+        return pending
+
+    # -- summary ------------------------------------------------------
+
+    def summary(self):
+        doc = super(RebalanceSoak, self).summary()
+        doc['rebalance'] = getattr(self, 'rebalance_doc', {})
+        doc['handoff'] = getattr(self, 'handoff_doc', {})
+        return doc
+
+
+def soak_rebalance(root, fast=False, verbose=True, floor=None):
+    """The live-resize drill under `root`; returns the summary
+    dict."""
+    mod_faults.reset()
+    ctx = make_corpus(root, n=400 if fast else 1200,
+                      days=5 if fast else 10)
+    for fmt in FORMATS:
+        build(ctx, fmt)
+    os.environ.update({
+        'DN_ROUTER_PROBE_MS': '200', 'DN_ROUTER_FAILURES': '3',
+        'DN_ROUTER_COOLDOWN_MS': '500', 'DN_ROUTER_HEDGE_MS': '0',
+        'DN_ROUTER_FETCH_TIMEOUT_S': '30',
+        'DN_REMOTE_RETRIES': '3', 'DN_REMOTE_BACKOFF_MS': '10',
+        'DN_REMOTE_CONNECT_TIMEOUT_S': '5',
+        'DN_SERVE_CLIENT_TIMEOUT_S': '60',
+        'DN_TOPO_POLL_MS': RebalanceSoak.POLL_MS,
+        'DN_TOPO_HANDOFF_RETRIES': '3'})
+    s = RebalanceSoak(ctx, verbose=verbose)
+    s.start_cluster()
+    prior_faults = os.environ.get('DN_FAULTS')
+    from dragnet_tpu.serve import topology as mod_topology
+    try:
+        s.note('fault-free routed byte-identity round (epoch 1)')
+        s.routed_rounds('', 1, degraded_ok=False)
+        rc_d = s.write_member_rc('d')
+        rc_e = s.write_member_rc('e')
+        os.environ['DN_FAULTS'] = REBALANCE_SPEC
+        mod_faults.reset()
+        s.note('flood starts (faults armed [%s])' % REBALANCE_SPEC)
+        s.start_flood(nthreads=2 if fast else 3)
+
+        # -- grow 3 -> 5: d and e join with EMPTY private trees;
+        # their shards stream from the committed owners.  e is a
+        # subprocess, SIGKILLed mid-handoff and restarted.
+        grow = {
+            'assign': 'hash',
+            'members': {
+                'a': {'endpoint': s.socks['a']},
+                'b': {'endpoint': s.socks['b']},
+                'c': {'endpoint': s.socks['c']},
+                'd': {'endpoint': s.socks['d'], 'config': rc_d},
+                'e': {'endpoint': s.socks['e'], 'config': rc_e},
+            },
+            'partitions': [
+                {'id': 0, 'replicas': ['a', 'b']},
+                {'id': 1, 'replicas': ['d', 'e']},
+                {'id': 2, 'replicas': ['c', 'd']},
+            ],
+        }
+        # publish first so the joiners' startup path reads the
+        # pending file (the fresh-joiner contract); slow e's fetches
+        # so the SIGKILL lands mid-pull
+        from dragnet_tpu.serve import coordinator as mod_coord
+        committed, pending = mod_coord.begin_transition(
+            s.topo_path, grow)
+        s.note('pending epoch %d published (grow 3 -> 5)'
+               % pending.epoch)
+        topo_d, pend_d = mod_topology.load_topology_state(
+            s.topo_path, member='d')
+        s.servers['d'] = mod_server.DnServer(
+            socket_path=s.socks['d'],
+            conf={'max_inflight': 8, 'queue_depth': 32,
+                  'deadline_ms': 0, 'coalesce': True,
+                  'drain_s': 10},
+            cluster=topo_d, member='d', pending=pend_d).start()
+        s.spawn_member('e', extra_env={
+            'DN_FAULTS': 'handoff.fetch:delay:1.0',
+            'DN_FAULT_DELAY_MS': '120'})
+        time.sleep(0.5)
+        proc = s.procs['e']
+        proc.kill()
+        proc.wait()
+        s.note('SIGKILLed joiner e mid-handoff')
+        ds0 = ctx['ds'][FORMATS[0]]
+        case = query_cases(ds0)[0]
+        got = run_cli(case[:1] + ['--remote', s.socks['a']] +
+                      case[1:])
+        s.check_routed(FORMATS[0], case, got, degraded_ok=False)
+        s.spawn_member('e')
+        s.note('restarted joiner e (re-pulls idempotently)')
+        status = mod_coord.wait_ready(s.topo_path,
+                                      timeout_s=60 if fast else 120,
+                                      poll_s=0.25)
+        if not status.get('ready'):
+            s.violate('grow transition never became ready: %s'
+                      % json.dumps(status))
+        else:
+            mod_coord.commit_transition(s.topo_path)
+            s.note('epoch 2 committed (5 members)')
+        s.wait_epoch('abcde', 2)
+        s.handoff_doc = (s.servers['d'].puller.status()
+                         if s.servers['d'].puller else {})
+        if not (s.handoff_doc.get('counters') or {}).get(
+                'shards_streamed'):
+            s.violate('joiner d streamed no shards into its empty '
+                      'tree: %s' % json.dumps(s.handoff_doc))
+
+        # -- the rebalance planner reads live member loads
+        from dragnet_tpu.serve import rebalance as mod_rebalance
+        topo_now = mod_topology.load_topology(s.topo_path)
+        loads = mod_rebalance.collect_loads(topo_now, timeout_s=10.0)
+        doc, decisions = mod_rebalance.propose_moves(topo_now, loads)
+        s.rebalance_doc = {'loads': {k: v for k, v in loads.items()},
+                           'decisions': decisions}
+        s.note('rebalance planner: %d move(s) proposed'
+               % len(decisions))
+
+        # -- SIGKILL a donor mid-flood (partition 0 fails over to a)
+        s.procs['b'].kill()
+        s.procs['b'].wait()
+        s.note('SIGKILLed member b (donor) mid-flood')
+
+        # -- shrink 5 -> 2: only a and d remain; d pulls everything
+        # it is missing (donors: the other committed owners)
+        shrink = {
+            'assign': 'hash',
+            'members': {
+                'a': {'endpoint': s.socks['a']},
+                'd': {'endpoint': s.socks['d'], 'config': rc_d},
+            },
+            'partitions': [
+                {'id': 0, 'replicas': ['a', 'd']},
+                {'id': 1, 'replicas': ['d', 'a']},
+                {'id': 2, 'replicas': ['a', 'd']},
+            ],
+        }
+        if s.resize(shrink,
+                    ready_timeout_s=90 if fast else 180) is not None:
+            s.wait_epoch('ad', 3)
+
+        s.stop_flood()
+        os.environ.pop('DN_FAULTS', None)
+        mod_faults.reset()
+
+        # -- retire the departed members; a + d own the world
+        s.servers['c'].stop()
+        s.procs['e'].kill()
+        s.procs['e'].wait()
+        s.note('departed members stopped (c, e; b already dead)')
+        s.note('final fault-free byte-identity via a and d')
+        for via in 'ad':
+            for fmt in FORMATS:
+                ds = ctx['ds'][fmt]
+                for case in query_cases(ds):
+                    got = run_cli(case[:1] +
+                                  ['--remote', s.socks[via]] +
+                                  case[1:])
+                    s.check_routed(fmt, case, got,
+                                   degraded_ok=False)
+        # topology telemetry reached /stats
+        doc = mod_client.stats(s.socks['a'], timeout_s=30.0)
+        topo_sec = doc.get('topology') or {}
+        if topo_sec.get('epoch') != 3:
+            s.violate('/stats topology epoch %r != 3'
+                      % topo_sec.get('epoch'))
+        if (topo_sec.get('counters') or {}).get('transitions', 0) \
+                < 2:
+            s.violate('/stats topology transitions < 2: %s'
+                      % json.dumps(topo_sec.get('counters')))
+        if floor:
+            extra = 0
+            while extra < 60:
+                total = mod_vpipe.global_counters().get(
+                    'faults injected', 0)
+                if total >= floor:
+                    break
+                extra += 1
+                os.environ['DN_FAULTS'] = REBALANCE_SPEC
+                mod_faults.reset()
+                s.note('top-up round %d (%d/%d faults)'
+                       % (extra, total, floor))
+                s.routed_rounds(REBALANCE_SPEC, 1)
+                os.environ.pop('DN_FAULTS', None)
+                mod_faults.reset()
+    finally:
+        if prior_faults is None:
+            os.environ.pop('DN_FAULTS', None)
+        else:
+            os.environ['DN_FAULTS'] = prior_faults
+        s.stop_cluster()
+    return s.summary()
+
+
 # -- continuous-ingest (dn follow) drill ------------------------------------
 
 # the appender: grows the log in fsynced bursts so the follower's
@@ -1475,16 +1882,25 @@ def main(argv=None):
                         '(~5x capacity, tenant weights, torn-frame/'
                         'stall/flood faults, mid-flood SIGKILL) '
                         'instead of the single-process soak')
+    p.add_argument('--rebalance', action='store_true',
+                   help='run the live-resize drill (grow 3->5 and '
+                        'shrink 5->2 members under flood with armed '
+                        'handoff/topology faults and mid-handoff '
+                        'SIGKILLs) instead of the single-process '
+                        'soak')
     p.add_argument('--min-faults', type=int, default=None,
                    help='required injected-fault floor '
                         '(default: 500, or 50 with --fast; the '
                         'follow drill defaults to 100/20, the '
-                        'overload drill to 60/15)')
+                        'overload drill to 60/15, the rebalance '
+                        'drill to 40/10)')
     args = p.parse_args(argv)
     if args.follow:
         default_floor = 20 if args.fast else 100
     elif args.overload:
         default_floor = 15 if args.fast else 60
+    elif args.rebalance:
+        default_floor = 10 if args.fast else 40
     else:
         default_floor = 50 if args.fast else 500
     floor = args.min_faults if args.min_faults is not None \
@@ -1494,7 +1910,8 @@ def main(argv=None):
     t0 = time.time()
     runner = soak_cluster if args.cluster \
         else soak_follow if args.follow \
-        else soak_overload if args.overload else soak
+        else soak_overload if args.overload \
+        else soak_rebalance if args.rebalance else soak
     with tempfile.TemporaryDirectory(prefix='dn_soak_') as root:
         summary = runner(root, fast=args.fast, floor=floor)
     summary['elapsed_s'] = round(time.time() - t0, 1)
