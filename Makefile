@@ -5,9 +5,9 @@
 PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
-    bench-serve bench-cluster bench-follow bench-fanin soak-faults \
-    soak-cluster soak-follow soak-overload soak-rebalance clean \
-    parity-matrix
+    bench-serve bench-cluster bench-follow bench-fanin bench-verify \
+    soak-faults soak-cluster soak-follow soak-overload \
+    soak-rebalance soak-scrub clean parity-matrix
 
 all: native
 
@@ -99,6 +99,20 @@ soak-overload: native
 # single-process goldens, zero dropped partitions, zero hangs
 soak-rebalance: native
 	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --rebalance
+
+# shard-integrity: flip random bytes in committed shards across a
+# 3-member cluster (private byte-identical trees) under routed flood
+# with DN_VERIFY=open + a 1s background scrub — asserts zero silently
+# wrong result bytes (every corruption detected as a clean retryable/
+# degraded error or transparently failed over) and every damaged
+# shard repaired from a co-replica, byte-identical to its catalog
+soak-scrub: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --scrub
+
+# verified-read overhead: warm + cold-open index-query p50/p95 under
+# DN_VERIFY=open vs off (bench extras JSON)
+bench-verify: native
+	$(PYTHON) bench.py --verify-only
 
 # high fan-in: pooled persistent multiplexed connections vs
 # dial-per-request p50/p95 on the cluster partial path + shed-rate

@@ -153,6 +153,20 @@ def gen_to_file(n, path, mindate_ms=None, maxdate_ms=None):
                     separators=(',', ':')).encode() + b'\n')
 
 
+def _count_shards(idx):
+    """Shard files in an index tree — build machinery (journals,
+    tmps, the integrity catalog) excluded, exactly as readers filter
+    the walk."""
+    from dragnet_tpu import index_journal as mod_journal
+    nshards = 0
+    for root, dirs, files in os.walk(idx):
+        dirs[:] = [d for d in dirs
+                   if not mod_journal.is_index_litter(d)]
+        nshards += sum(1 for f in files
+                       if not mod_journal.is_index_litter(f))
+    return nshards
+
+
 def make_ds(datafile, indexdir=None):
     from dragnet_tpu.datasource_file import DatasourceFile
     bc = {'path': datafile}
@@ -283,9 +297,7 @@ def index_query_bench(tmpdir):
     t0 = time.monotonic()
     ds.build(metrics, 'day')
     build_s = time.monotonic() - t0
-    nshards = 0
-    for root, dirs, files in os.walk(idx):
-        nshards += len(files)
+    nshards = _count_shards(idx)
 
     def q(after=None, before=None):
         conf = {'breakdowns': [{'name': 'host'},
@@ -433,9 +445,7 @@ def index_build_bench(tmpdir):
             ds.build(metrics, 'day')
             times.append(time.monotonic() - t0)
         build_s = min(times)
-        nshards = 0
-        for root, dirs, files in os.walk(idx):
-            nshards += len(files)
+        nshards = _count_shards(idx)
 
         # prepare the columnar blocks once (untimed): the index-write
         # phase is then measured alone, against the same inputs the
@@ -861,9 +871,7 @@ def serve_bench(tmpdir):
     metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
     ds = make_ds(datafile, idx)
     ds.build(metrics, 'day')
-    nshards = 0
-    for root, dirs, files in os.walk(idx):
-        nshards += len(files)
+    nshards = _count_shards(idx)
 
     env = dict(os.environ, DRAGNET_CONFIG=rc_path)
     dn = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1078,9 +1086,7 @@ def cluster_bench(tmpdir):
     metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
     ds = make_ds(datafile, idx)
     ds.build(metrics, 'day')
-    nshards = 0
-    for root, dirs, files in os.walk(idx):
-        nshards += len(files)
+    nshards = _count_shards(idx)
 
     socks = {m: os.path.join(tmpdir, 'dn-%s.sock' % m) for m in 'abc'}
     topo_path = os.path.join(tmpdir, 'topo.json')
@@ -1559,6 +1565,105 @@ def fanin_bench(tmpdir):
     }
 
 
+def verified_read_bench(tmpdir):
+    """Verified-read overhead (integrity.py): the warm index-query
+    path under DN_VERIFY=off vs open, recorded honestly so the
+    default can be chosen on data.  `open` verifies size+crc32 only
+    on FRESH shard-handle opens (the handle cache amortizes it), so
+    the warm p50 should be ~flat; the cold leg (cache cleared per
+    rep: every open verifies) is the worst case the knob can cost."""
+    from dragnet_tpu import index_query_mt as mod_iqmt
+    from dragnet_tpu import integrity as mod_integrity
+    datafile = os.path.join(tmpdir, 'verify.log')
+    idx = os.path.join(tmpdir, 'verify.idx')
+    n = 200000
+    start_ms = 1388534400000             # 2014-01-01, 60 daily shards
+    gen_to_file(n, datafile, mindate_ms=start_ms,
+                maxdate_ms=start_ms + 60 * 86400000)
+    ds = make_ds(datafile, idx)
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    ds.build(metrics, 'day')
+    nshards = len(list(mod_integrity.iter_tree_shards(idx)))
+    conf = {'breakdowns': [{'name': 'host'},
+                           {'name': 'latency', 'aggr': 'quantize'}],
+            'filter': {'eq': ['req.method', 'GET']}}
+    query = mod_query.query_load(conf)
+
+    def measure(reps, cold=False):
+        times = []
+        for _ in range(reps):
+            if cold:
+                mod_iqmt.shard_cache_clear()
+            t0 = time.monotonic()
+            ds.query(query, 'day')
+            times.append((time.monotonic() - t0) * 1000)
+        times.sort()
+        return (times[len(times) // 2],
+                times[min(len(times) - 1, int(len(times) * 0.95))])
+
+    out = {'verify_shards': nshards}
+    prior = os.environ.get('DN_VERIFY')
+    try:
+        for mode in ('off', 'open'):
+            os.environ['DN_VERIFY'] = mode
+            mod_integrity.reset_memo()
+            mod_iqmt.shard_cache_clear()
+            ds.query(query, 'day')          # warm the handle cache
+            warm_p50, warm_p95 = measure(15)
+            cold_p50, cold_p95 = measure(5, cold=True)
+            out['verify_%s_warm_p50_ms' % mode] = round(warm_p50, 3)
+            out['verify_%s_warm_p95_ms' % mode] = round(warm_p95, 3)
+            out['verify_%s_cold_p50_ms' % mode] = round(cold_p50, 3)
+            out['verify_%s_cold_p95_ms' % mode] = round(cold_p95, 3)
+    finally:
+        if prior is None:
+            os.environ.pop('DN_VERIFY', None)
+        else:
+            os.environ['DN_VERIFY'] = prior
+        mod_integrity.reset_memo()
+        mod_iqmt.shard_cache_clear()
+    off, on = out['verify_off_warm_p50_ms'], \
+        out['verify_open_warm_p50_ms']
+    out['verify_open_warm_overhead_pct'] = \
+        round((on - off) / off * 100.0, 1) if off else None
+    coff, con = out['verify_off_cold_p50_ms'], \
+        out['verify_open_cold_p50_ms']
+    out['verify_open_cold_overhead_pct'] = \
+        round((con - coff) / coff * 100.0, 1) if coff else None
+    return out
+
+
+def main_verify():
+    """Verified-read legs only (`make bench-verify` /
+    --verify-only)."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_verify_')
+    try:
+        vb = verified_read_bench(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    sys.stderr.write(
+        'bench-verify: %d shards; warm p50 open %.1fms vs off %.1fms '
+        '(%+.1f%%), p95 %.1f/%.1fms; cold-open p50 open %.1fms vs '
+        'off %.1fms (%+.1f%%)\n'
+        % (vb['verify_shards'], vb['verify_open_warm_p50_ms'],
+           vb['verify_off_warm_p50_ms'],
+           vb['verify_open_warm_overhead_pct'] or 0.0,
+           vb['verify_open_warm_p95_ms'],
+           vb['verify_off_warm_p95_ms'],
+           vb['verify_open_cold_p50_ms'],
+           vb['verify_off_cold_p50_ms'],
+           vb['verify_open_cold_overhead_pct'] or 0.0))
+    print(json.dumps({
+        'metric': 'verify_open_warm_overhead_pct',
+        'value': vb['verify_open_warm_overhead_pct'],
+        'unit': 'pct',
+        'vs_baseline': None,
+        'extra': vb,
+    }))
+
+
 def main_fanin():
     """High fan-in legs only (`make bench-fanin` / --fanin-only)."""
     import shutil
@@ -1726,6 +1831,9 @@ def main():
     if '--fanin-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'fanin':
         return main_fanin()
+    if '--verify-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'verify':
+        return main_verify()
     nrecords = int(os.environ.get('DN_BENCH_RECORDS', '300000'))
     large_n = int(os.environ.get('DN_BENCH_LARGE_RECORDS', '2000000'))
     host_sample = min(nrecords, 50000)

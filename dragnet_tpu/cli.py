@@ -138,6 +138,17 @@ DN_OPTIONS = [
     (['wait'], 'string', None),
     (['force'], 'bool', None),
     (['apply'], 'bool', None),
+    # `dn scrub` / `dn quarantine` integrity options: --tree limits
+    # the walk to one index root, --repair pulls good copies from
+    # cluster co-replicas, --check reports without quarantining,
+    # --forget-missing drops catalog entries for shards gone from
+    # disk, --older-than age-gates `dn quarantine clean`.  Not in
+    # USAGE_TEXT (byte-pinned); documented in docs/robustness.md.
+    (['tree'], 'string', None),
+    (['repair'], 'bool', None),
+    (['check'], 'bool', None),
+    (['forget-missing'], 'bool', None),
+    (['older-than'], 'string', None),
     # per-run request tracing (equivalent to DN_TRACE=stderr for one
     # command; composes with --remote — the client ships its trace id
     # and grafts the server's span subtree).  Not in USAGE_TEXT: the
@@ -1022,6 +1033,215 @@ def cmd_follow(ctx, argv):
         fatal(e)
 
 
+def _parse_age(raw):
+    """'30s' / '15m' / '12h' / '7d' (or bare seconds) -> seconds."""
+    mult = {'s': 1, 'm': 60, 'h': 3600, 'd': 86400}
+    val, unit = raw, 1
+    if raw and raw[-1] in mult:
+        val, unit = raw[:-1], mult[raw[-1]]
+    try:
+        seconds = float(val) * unit
+        if seconds < 0:
+            raise ValueError(raw)
+    except ValueError:
+        raise UsageError('bad value for "older-than": "%s"' % raw)
+    return seconds
+
+
+def _integrity_trees(opts):
+    """[(dsname-or-None, indexroot)] a scrub/quarantine walk covers:
+    the --tree override, else every configured file datasource's
+    index tree."""
+    from . import integrity as mod_integrity
+    if opts.tree:
+        return [(None, opts.tree)]
+    try:
+        trees = mod_integrity.configured_index_trees()
+    except DNError as e:
+        fatal(e)
+    if not trees:
+        fatal(DNError('no index trees configured (and no --tree '
+                      'given)'))
+    return trees
+
+
+def cmd_scrub(ctx, argv):
+    """`dn scrub [--tree T] [--check] [--forget-missing]
+    [--repair --cluster TOPO.json --member NAME]
+    [--remote SOCK|HOST:PORT]`: walk index trees comparing shard
+    bytes against the integrity catalog (integrity.py).  Mismatches
+    quarantine (--check reports only); --repair pulls good copies
+    from committed cluster co-replicas; --remote asks a resident
+    server to run the pass itself (tree-locked, plus anti-entropy in
+    cluster mode).  Exits 0 only when the trees are clean (or fully
+    repaired).  Not in USAGE_TEXT — the usage output is byte-pinned
+    to the reference goldens; documented in docs/robustness.md."""
+    import json as mod_json
+    opts = dn_parse_args(argv, ['tree', 'check', 'forget-missing',
+                                'repair', 'remote', 'cluster',
+                                'member'])
+    check_arg_count(opts, 0)
+    if opts.remote:
+        from .serve import client as mod_serve_client
+        req = {'op': 'scrub',
+               'repair': bool(getattr(opts, 'repair', None)),
+               'check': bool(getattr(opts, 'check', None))}
+        try:
+            rc, header, out, err = mod_serve_client.request_bytes(
+                opts.remote, req, timeout_s=600.0)
+        except (OSError, ValueError, DNError) as e:
+            fatal(DNError('serve endpoint "%s" unreachable'
+                          % opts.remote, cause=DNError(str(e))))
+        sys.stderr.write(err.decode('utf-8', 'replace'))
+        sys.stdout.write(out.decode('utf-8', 'replace'))
+        if rc != 0:
+            return rc
+        try:
+            doc = mod_json.loads(out.decode('utf-8'))
+        except ValueError:
+            return 1
+        dirty = sum((t.get('corrupt', 0) + t.get('missing', 0))
+                    for t in (doc.get('trees') or {}).values())
+        return 0 if dirty == 0 else 1
+    conf = mod_config.integrity_config()
+    if isinstance(conf, DNError):
+        fatal(conf)
+    if (opts.cluster is None) != (opts.member is None):
+        raise UsageError('"--cluster" and "--member" must be used '
+                         'together')
+    topo = None
+    if opts.cluster is not None:
+        from .serve import topology as mod_topology
+        try:
+            topo = mod_topology.load_topology(opts.cluster,
+                                              member=opts.member)
+        except DNError as e:
+            fatal(e)
+    if getattr(opts, 'repair', None) and topo is None:
+        raise UsageError('"--repair" needs donors: use --remote '
+                         'against a cluster member, or --cluster/'
+                         '--member with a topology file')
+    from . import integrity as mod_integrity
+    trees = _integrity_trees(opts)
+    if opts.tree and trees[0][0] is None:
+        # a bare --tree path carries no datasource name; repair needs
+        # one (the donor's shard_fetch resolves its tree by ds) —
+        # recover it from the configured datasources, or refuse
+        # rather than fail every donor fetch with a confusing error
+        import os
+        want = os.path.abspath(opts.tree)
+        try:
+            for dsname, root in \
+                    mod_integrity.configured_index_trees():
+                if os.path.abspath(root) == want:
+                    trees = [(dsname, opts.tree)]
+                    break
+        except DNError:
+            pass
+        if trees[0][0] is None and getattr(opts, 'repair', None):
+            fatal(DNError('"--repair" with "--tree": "%s" matches '
+                          'no configured datasource, so donors '
+                          'cannot serve it' % opts.tree))
+    rate = conf['scrub_rate_mb_s'] << 20
+    summary = {}
+    dirty = 0
+    for dsname, root in trees:
+        res = mod_integrity.scrub_tree(
+            root, quarantine=not getattr(opts, 'check', None),
+            forget_missing=bool(getattr(opts, 'forget_missing',
+                                        None)),
+            rate_bytes_s=rate)
+        res['repaired'] = 0
+        if getattr(opts, 'repair', None) and topo is not None:
+            res['repaired'] = _scrub_repair(
+                topo, opts.member, dsname, root,
+                res['corrupt_shards'] + res['missing_shards'])
+        summary[root] = res
+        dirty += res['corrupt'] + res['missing'] - res['repaired']
+    sys.stdout.write(mod_json.dumps(summary, indent=2,
+                                    sort_keys=True) + '\n')
+    return 0 if dirty == 0 else 1
+
+
+def _scrub_repair(topo, member, dsname, indexroot, rels):
+    """Pull damaged/missing shards from committed co-replicas (the
+    offline `dn scrub --repair` leg; a resident member repairs
+    itself through serve/scrub.py instead).  Returns how many
+    landed."""
+    import os
+    from . import integrity as mod_integrity
+    from .serve import rebalance as mod_rebalance
+    from .serve import scrub as mod_scrub
+    topo_conf = mod_config.topo_config()
+    if isinstance(topo_conf, DNError):
+        fatal(topo_conf)
+    catalog = mod_integrity.load_catalog(indexroot)
+    repaired = 0
+    for rel in rels:
+        expected = catalog.get(rel)
+        if expected is None:
+            continue
+        dest = os.path.join(os.path.abspath(indexroot), rel)
+        pid = topo.partition_of(dest, mod_scrub.rel_timeformat(rel))
+        donors = [m for m in topo.replicas(pid) if m != member]
+        for donor in donors:
+            try:
+                mod_rebalance.land_shard(
+                    topo.endpoint(donor), dsname, None, topo.epoch,
+                    rel, expected[0], expected[1], dest,
+                    topo_conf['handoff_timeout_s'],
+                    indexroot=indexroot)
+                repaired += 1
+                break
+            except (OSError, ValueError, DNError):
+                continue
+    return repaired
+
+
+def cmd_quarantine(ctx, argv):
+    """`dn quarantine list|clean [--older-than AGE] [--tree T]`:
+    inspect and prune `.dn_quarantine/` — the forensics directory
+    every crash rollback and corrupt-detect moves artifacts into,
+    and nothing ever pruned before this command existed.  AGE:
+    '30s'/'15m'/'12h'/'7d' or bare seconds (clean defaults to
+    everything).  Not in USAGE_TEXT (byte-pinned); documented in
+    docs/robustness.md."""
+    from . import integrity as mod_integrity
+    opts = dn_parse_args(argv, ['tree', 'older-than'])
+    if len(opts._args) < 1:
+        raise UsageError('missing quarantine subcommand')
+    sub = opts._args[0]
+    if sub == 'list':
+        check_arg_count(opts, 1)
+        total_files = 0
+        total_bytes = 0
+        for dsname, root in _integrity_trees(opts):
+            for name, size, age_s, path in \
+                    mod_integrity.quarantine_entries(root):
+                sys.stdout.write('%12d %10ds %s\n'
+                                 % (size, int(age_s), path))
+                total_files += 1
+                total_bytes += size
+        sys.stderr.write('dn quarantine: %d file(s), %d byte(s)\n'
+                         % (total_files, total_bytes))
+        return 0
+    if sub == 'clean':
+        check_arg_count(opts, 1)
+        age_s = _parse_age(opts.older_than) \
+            if opts.older_than is not None else 0
+        removed = 0
+        freed = 0
+        for dsname, root in _integrity_trees(opts):
+            n, b = mod_integrity.quarantine_clean(
+                root, older_than_s=age_s)
+            removed += n
+            freed += b
+        sys.stderr.write('dn quarantine: removed %d file(s), '
+                         'freed %d byte(s)\n' % (removed, freed))
+        return 0
+    raise UsageError('unknown quarantine subcommand: "%s"' % sub)
+
+
 def cmd_topo(ctx, argv):
     """`dn topo show|status|apply|commit|abort|rebalance
     [--topology T.json] ...`: dynamic cluster topology management
@@ -1185,6 +1405,9 @@ def cmd_serve(ctx, argv):
     obs_conf = mod_config.obs_config()
     if isinstance(obs_conf, DNError):
         fatal(obs_conf)
+    integ_conf = mod_config.integrity_config()
+    if isinstance(integ_conf, DNError):
+        fatal(integ_conf)
 
     cluster = opts.cluster or os.environ.get('DN_SERVE_TOPOLOGY') \
         or None
@@ -1264,6 +1487,11 @@ def cmd_serve(ctx, argv):
             'handoff_retries=%d max_moves=%d\n'
             % (topo_conf['poll_ms'], topo_conf['handoff_timeout_s'],
                topo_conf['handoff_retries'], topo_conf['max_moves']))
+        sys.stdout.write(
+            'integrity config ok: verify=%s scrub_interval_s=%d '
+            'scrub_rate_mb_s=%d\n'
+            % (integ_conf['verify'], integ_conf['scrub_interval_s'],
+               integ_conf['scrub_rate_mb_s']))
         if topo is not None:
             sys.stdout.write(
                 'cluster topology ok: member=%s epoch=%d assign=%s '
@@ -1318,7 +1546,9 @@ COMMANDS = {
     'index-read': cmd_index_read,
     'index-scan': cmd_index_scan,
     'query': cmd_query,
+    'quarantine': cmd_quarantine,
     'scan': cmd_scan,
+    'scrub': cmd_scrub,
     'serve': cmd_serve,
     'stats': cmd_stats,
     'topo': cmd_topo,

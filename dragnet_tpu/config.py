@@ -613,6 +613,64 @@ def follow_config(env=None):
     return rv
 
 
+# --- shard-integrity knobs (DN_VERIFY / DN_SCRUB_*) -------------------
+#
+# Same contract as the serve/remote knobs: parsed and validated in one
+# place (integrity.py and serve/scrub.py read the env forgivingly at
+# runtime; THIS is where malformed values are rejected, checked up
+# front by `dn serve --validate`).
+
+_SCRUB_KNOBS = [
+    # background scrub cadence in `dn serve`: walk every configured
+    # tree comparing bytes against the integrity catalog (and, in
+    # cluster mode, run anti-entropy against co-replicas).  0 (the
+    # default) disables the thread; `dn scrub` runs a pass on demand.
+    ('DN_SCRUB_INTERVAL_S', 'int', 0, 1),
+    # scrub read-bandwidth bound (MB/s); the scrub is a janitor and
+    # must never compete with the serving path for disk.  0 =
+    # unlimited.
+    ('DN_SCRUB_RATE_MB_S', 'int', 64, 0),
+]
+
+
+def integrity_config(env=None):
+    """The resolved integrity knobs (keys: verify, scrub_interval_s,
+    scrub_rate_mb_s), or DNError on the first malformed value.
+
+    * DN_VERIFY: 'off' (default — byte-identical to the unverified
+      path), 'open' (size+crc32 checked against the tree's integrity
+      catalog on first shard-handle open, amortized by the handle
+      cache), or 'full' (re-verified on every lease).
+    """
+    if env is None:
+        env = os.environ
+    rv = {}
+    raw = env.get('DN_VERIFY')
+    if raw is None or raw == '':
+        rv['verify'] = 'off'
+    elif raw in ('off', 'open', 'full'):
+        rv['verify'] = raw
+    else:
+        return DNError('DN_VERIFY: expected "off", "open" or '
+                       '"full", got "%s"' % raw)
+    for name, kind, default, minimum in _SCRUB_KNOBS:
+        key = name[len('DN_'):].lower()
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            value = None
+        if value is None or (value != 0 and value < minimum) or \
+                value < 0:
+            return DNError('%s: expected 0 or an integer >= %d, '
+                           'got "%s"' % (name, minimum, raw))
+        rv[key] = value
+    return rv
+
+
 # --- observability knobs (DN_TRACE / DN_SLOW_MS / DN_METRICS_BUCKETS) -
 #
 # Same contract as the serve/remote knobs: parsed and validated in one

@@ -1041,6 +1041,20 @@ class DatasourceFile(object):
             index_list.bump_hidden('index shards pruned', npruned)
         index_list.bump_hidden('index shards queried', len(paths))
 
+        # verified reads (integrity.py): a catalogued shard that is
+        # MISSING from the walk (quarantined after a corrupt detect,
+        # or externally deleted) must degrade explicitly — a clean
+        # retryable error naming the shard — never silently short
+        # result bytes
+        from . import integrity as mod_integrity
+        if mod_integrity.verify_mode() != 'off':
+            mod_integrity.check_missing(
+                self.ds_indexpath, paths,
+                subdir=os.path.basename(root)
+                if timeformat is not None else None,
+                timeformat=timeformat, after_ms=query.qc_after,
+                before_ms=query.qc_before)
+
         nworkers = mod_iqmt.iq_threads()
         LOG.debug('query start', indexroot=root, nindexes=len(paths),
                   npruned=npruned, nworkers=nworkers,

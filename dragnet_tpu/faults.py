@@ -26,6 +26,15 @@ deterministic regardless.)  Kinds:
   cut.  Sites without a torn_path degrade to ``error``.
 * ``kill``  — SIGKILL the process at the seam (mid-flush crash
   drills; only meaningful under a subprocess harness).
+* ``flip``  — silent corruption: at sites that hand a file path
+  (``flip_path``, or ``torn_path`` where no safer target exists),
+  XOR one seeded-random byte of the target file and CONTINUE — the
+  bit rot the integrity catalog (integrity.py) exists to catch.
+  Armed at ``sink.rename`` (the file flipped is the prepared tmp,
+  AFTER its checksum landed in the commit record, so the committed
+  shard disagrees with the catalog exactly like post-publish rot)
+  and ``handoff.apply``; sites without a path degrade to ``error``,
+  mirroring ``torn``.
 
 Every check and every firing is counted per site (stats(), plus the
 hidden 'fault injected <site>' global counters `dn serve` surfaces in
@@ -46,7 +55,7 @@ import time
 from .errors import DNError
 from .vpipe import counter_bump
 
-KINDS = ('error', 'torn', 'delay', 'kill')
+KINDS = ('error', 'torn', 'delay', 'kill', 'flip')
 
 # the injection-site catalog (docs/robustness.md documents each seam)
 SITES = (
@@ -147,11 +156,15 @@ def _delay_s():
         return 0.025
 
 
-def fire(site, torn_path=None):
+def fire(site, torn_path=None, flip_path=None):
     """The injection seam: no-op unless DN_FAULTS arms `site`; on a
     hit, act per the armed kind (see module docstring).  `torn_path`
     names the bytes a 'torn' kind may cut short (the sink's tmp
-    file)."""
+    file); `flip_path` the bytes a 'flip' kind may corrupt in place
+    (falling back to torn_path — distinct parameters because a site
+    where a torn tmp would be rolled FORWARD by recovery, like the
+    sink commit seam, can safely hand flip a target it must never
+    hand torn)."""
     table = _registry()
     if isinstance(table, DNError):
         raise table
@@ -163,6 +176,11 @@ def fire(site, torn_path=None):
         hit = ent.rng.random() < ent.rate
         if hit:
             ent.fired += 1
+            if ent.kind == 'flip':
+                # the flip's offset/mask draws come off the same
+                # seeded stream, so a given spec corrupts replayably
+                flip_draw = (ent.rng.random(),
+                             ent.rng.randrange(1, 256))
     if not hit:
         return
     counter_bump('faults injected')
@@ -183,7 +201,34 @@ def fire(site, torn_path=None):
     if kind == 'torn' and torn_path is not None:
         _tear(torn_path)
         os.kill(os.getpid(), signal.SIGKILL)
+    if kind == 'flip':
+        target = flip_path if flip_path is not None else torn_path
+        if target is not None:
+            _flip(target, flip_draw[0], flip_draw[1])
+            return           # silent: the corruption IS the fault
     raise FaultInjected('injected %s fault at "%s"' % (kind, site))
+
+
+def _flip(path, offset_frac, mask):
+    """XOR one byte of `path` at a seeded-random offset — silent bit
+    rot, injected (best-effort: an unreadable target simply stays
+    uncorrupted; the draw already happened so replay is intact)."""
+    try:
+        size = os.path.getsize(path)
+        if size == 0:
+            return
+        off = min(size - 1, int(offset_frac * size))
+        with open(path, 'r+b') as f:
+            f.seek(off)
+            b = f.read(1)
+            if not b:
+                return
+            f.seek(off)
+            f.write(bytes([b[0] ^ mask]))
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        pass
 
 
 def _tear(path):

@@ -299,13 +299,27 @@ def publish_prepared(journal, sinks, paths, extra_paths=None):
     the recovery sweep finishes the publish once this process dies,
     or the next build over the tree supersedes the intent
     (index_journal.cleanup_own_stale).  The earliest bucket-order
-    error still re-raises so the caller reports the failure."""
+    error still re-raises so the caller reports the failure.
+
+    Integrity: every prepared shard tmp is checksummed (size + crc32)
+    BEFORE the commit record lands; the checksums ride the record (so
+    a crash between record and catalog is recovered by the sweep's
+    roll-forward) and land in the per-tree `.dn_integrity.json`
+    catalog after the renames — verified reads (DN_VERIFY) and `dn
+    scrub` compare committed bytes against exactly what this publish
+    wrote.  extra_paths (the follow checkpoint, not a shard) are
+    excluded: the catalog describes the queryable shard set."""
+    from . import integrity as mod_integrity
     from .index_query_mt import shard_cache_invalidate
     from .obs import metrics as obs_metrics
     extra_paths = list(extra_paths or [])
     with obs_metrics.timed_stage('index_build.commit',
                                  nshards=len(paths)):
-        journal.record_commit(list(paths) + extra_paths)
+        integ = mod_integrity.integrity_entries(
+            [os.path.abspath(p) for p in paths],
+            tmp_for=journal.tmp_for)
+        journal.record_commit(list(paths) + extra_paths,
+                              integrity=integ)
         err = None
         for sink, path in zip(sinks, paths):
             try:
@@ -322,6 +336,7 @@ def publish_prepared(journal, sinks, paths, extra_paths=None):
                     err = e
         if err is not None:
             raise err
+        mod_integrity.record_published(integ)
         journal.retire()
 
 

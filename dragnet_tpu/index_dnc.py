@@ -437,7 +437,12 @@ class DncIndexSink(object):
             self._delegate.commit(discard_on_error=discard_on_error)
             return
         try:
-            mod_faults.fire('sink.rename')
+            # flip_path: corrupt the tmp AFTER its checksum landed in
+            # the commit record — the injected post-publish rot the
+            # integrity catalog exists to catch (torn stays unarmed
+            # here: a torn tmp would be rolled forward as-is)
+            mod_faults.fire('sink.rename',
+                            flip_path=self.is_dbtmpfilename)
             os.rename(self.is_dbtmpfilename, self.is_dbfilename)
         except BaseException:
             if discard_on_error:

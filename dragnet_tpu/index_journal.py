@@ -55,6 +55,10 @@ from .vpipe import counter_bump
 
 JOURNAL_PREFIX = '.dn_build.'
 QUARANTINE_DIR = '.dn_quarantine'
+# the per-tree integrity catalog (integrity.py): (size, crc32) of
+# every committed shard, updated through the publish/recovery paths
+# in this module so it can never disagree with a committed tree
+INTEGRITY_NAME = '.dn_integrity.json'
 # `dn follow`'s durable state (checkpoint.json, the mini-batch spool)
 # lives under this subdirectory of the index root; its checkpoint
 # publishes through the SAME commit journal as the shards, so the
@@ -92,7 +96,18 @@ def is_index_litter(name):
     return (base.startswith(JOURNAL_PREFIX) or
             base == QUARANTINE_DIR or
             base == FOLLOW_DIR or
+            base.startswith(INTEGRITY_NAME) or
             _TMP_RE.match(base) is not None)
+
+
+def is_durable_metadata(name):
+    """True for tree metadata that readers filter from shard walks
+    but that is NOT litter: the committed integrity catalog and its
+    cross-process flock sidecar.  Litter checkers (the soaks' zero-
+    torn-shards invariant) exempt these; catalog `.tmp`s stay
+    litter."""
+    base = os.path.basename(name)
+    return base in (INTEGRITY_NAME, INTEGRITY_NAME + '.lock')
 
 
 def _tmp_owner_pid(name):
@@ -141,11 +156,16 @@ class BuildJournal(object):
     def tmp_for(self, final):
         return final + '.' + self.tmp_suffix
 
-    def record_commit(self, final_paths):
+    def record_commit(self, final_paths, integrity=None):
         """THE commit point: atomically publish the (tmp, final) list.
         Every tmp must already be complete on disk.  After this
         record lands, the build WILL be observed (the renames below,
-        or the recovery sweep's roll-forward)."""
+        or the recovery sweep's roll-forward).  `integrity` is the
+        shard set's {indexroot: {relpath: (size, crc)}} checksum map
+        (integrity.integrity_entries, hashed from the prepared tmps):
+        riding the commit record means the sweep's roll-forward can
+        land the SAME catalog entries the in-process publish would
+        have — the catalog never disagrees with a committed tree."""
         self.entries = [(self.tmp_for(os.path.abspath(p)),
                          os.path.abspath(p)) for p in final_paths]
         # wall clock ON PURPOSE (clock-audit, PR 7): this is a
@@ -154,6 +174,11 @@ class BuildJournal(object):
         doc = {'pid': os.getpid(), 'build_id': self.build_id,
                'state': 'commit', 'time': time.time(),
                'entries': [[t, f] for t, f in self.entries]}
+        if integrity:
+            doc['integrity'] = {
+                root: {rel: [size, crc]
+                       for rel, (size, crc) in entries.items()}
+                for root, entries in integrity.items()}
         tmp = self.path + '.tmp'
         # a zero-bucket build never had a sink create indexroot, but
         # the commit record still lands there
@@ -195,7 +220,9 @@ def _quarantine(indexroot, path):
 def _roll_forward(indexroot, jpath, doc, result):
     """Finish a dead build's renames from its commit record, then
     retire the journal.  Idempotent: already-renamed entries have no
-    tmp left."""
+    tmp left.  The record's integrity map (when present) lands in the
+    per-tree catalog exactly as the dead publisher would have landed
+    it — a recovered tree verifies like a cleanly published one."""
     from .index_query_mt import shard_cache_invalidate
     for tmp, final in (doc.get('entries') or []):
         if os.path.exists(tmp):
@@ -204,6 +231,18 @@ def _roll_forward(indexroot, jpath, doc, result):
                 shard_cache_invalidate(final)
             except OSError:
                 _quarantine(indexroot, tmp)
+    integ = doc.get('integrity')
+    if isinstance(integ, dict):
+        from . import integrity as mod_integrity
+        try:
+            mod_integrity.record_published({
+                root: {rel: (ent[0], ent[1])
+                       for rel, ent in entries.items()
+                       if isinstance(ent, list) and len(ent) == 2}
+                for root, entries in integ.items()
+                if isinstance(entries, dict)})
+        except OSError:
+            pass
     counter_bump('index recovery rollforwards')
     result['rollforwards'] += 1
     try:
@@ -228,6 +267,17 @@ def sweep_index_tree(indexroot):
 
     live_tmps = set()
     for name in names:
+        if name.startswith(INTEGRITY_NAME + '.') and \
+                name.endswith('.tmp'):
+            # a catalog update cut short mid-write: the committed
+            # catalog (renamed atomically) is untouched; the torn tmp
+            # of a dead writer is litter
+            parts = name.split('.')
+            pid = int(parts[-2]) if len(parts) >= 2 and \
+                parts[-2].isdigit() else None
+            if pid is None or not _pid_alive(pid):
+                _quarantine(indexroot, os.path.join(indexroot, name))
+            continue
         if not name.startswith(JOURNAL_PREFIX):
             continue
         jpath = os.path.join(indexroot, name)

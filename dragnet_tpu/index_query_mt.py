@@ -298,11 +298,32 @@ def checkout_shard(path):
     """Lease a querier for `path`: a cached handle when its stat
     identity still matches (verified at most once per stat TTL), a
     fresh open otherwise.  Raises the same DNError('index "<path>"')
-    the sequential path raises on a bad open."""
+    the sequential path raises on a bad open.
+
+    Verified reads (integrity.py): under DN_VERIFY=open the shard's
+    size+crc32 are checked against the tree's integrity catalog on
+    every FRESH open — the cache's (path, mtime_ns, size, ino)
+    identity then amortizes it, so the hot serving path pays the read
+    once per shard generation.  DN_VERIFY=full re-verifies on every
+    lease, cache hit or not.  A mismatch quarantines the shard, bumps
+    its cache generation (a concurrently-leased handle closes at
+    checkin instead of re-entering), and raises the clean retryable
+    ShardIntegrityError."""
+    from . import integrity as mod_integrity
+    vmode = mod_integrity.verify_mode()
     if _cache_capacity() > 0:
         with _CACHE_LOCK:
             handle = _CACHE.pop(path, None)
         if handle is not None:
+            if vmode == 'full':
+                try:
+                    mod_integrity.verify_shard(path)
+                except mod_integrity.ShardIntegrityError:
+                    # the quarantine bumped the generation this
+                    # handle was cached under; close it here (it was
+                    # popped, so checkin will never see it)
+                    handle.querier.close()
+                    raise
             now = time.monotonic()
             if now - handle.checked_at < _stat_ttl():
                 with _CACHE_LOCK:
@@ -327,6 +348,10 @@ def checkout_shard(path):
                 handle.leased = True
                 return handle
             handle.querier.close()    # rewritten underneath the cache
+    if vmode != 'off':
+        # a fresh open: this path was not in the cache (or the cache
+        # is off/stale), so the generation pays its one verification
+        mod_integrity.verify_shard(path)
     with _CACHE_LOCK:
         _CACHE_STATS['misses'] += 1
         gen = (_EPOCH[0], _INVAL_GEN.get(path, 0))
@@ -512,7 +537,11 @@ def query_shard_once(path, query):
     fan-in (lib/datasource-file.js:629-689).  Returns the shard's
     aggregate as key items (Aggregator.key_items order) — replaying
     them with write_key() merges byte-identically to re-writing the
-    shard's points."""
+    shard's points.  Every open here is fresh, so DN_VERIFY=open and
+    =full both verify every read on this path."""
+    from . import integrity as mod_integrity
+    if mod_integrity.verify_mode() != 'off':
+        mod_integrity.verify_shard(path)
     try:
         querier = open_index(path)
     except DNError as e:

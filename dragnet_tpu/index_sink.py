@@ -210,12 +210,16 @@ class IndexSink(object):
         """Phase 2: atomically rename the prepared tmp into place.
         (No torn kind here: past the commit record the tmp must stay
         complete so the recovery roll-forward publishes whole bytes —
-        kill/error/delay still apply.)  Journaled publishers pass
+        kill/error/delay still apply.  The flip kind DOES target the
+        tmp: its checksum already landed in the commit record, so a
+        flipped byte models post-publish rot the integrity catalog
+        must catch.)  Journaled publishers pass
         discard_on_error=False: their commit record makes the tmp
         recoverable state, not litter."""
         from . import faults as mod_faults
         try:
-            mod_faults.fire('sink.rename')
+            mod_faults.fire('sink.rename',
+                            flip_path=self.is_dbtmpfilename)
             os.rename(self.is_dbtmpfilename, self.is_dbfilename)
         except BaseException:
             if discard_on_error:

@@ -46,9 +46,8 @@ from ..errors import DNError
 from .. import config as mod_config
 from .. import faults as mod_faults
 from .. import index_journal as mod_journal
+from .. import integrity as mod_integrity
 from ..obs import metrics as obs_metrics
-
-_CRC_CHUNK = 1 << 20
 
 # shards larger than this stream in bounded range-fetches instead of
 # one buffered response: the protocol buffers whole payloads on both
@@ -56,19 +55,10 @@ _CRC_CHUNK = 1 << 20
 # joiner) to OOM mid-resize
 FETCH_CHUNK_BYTES = 8 << 20
 
-
-def file_crc(path):
-    """(size, crc32) of a file, streamed."""
-    crc = 0
-    size = 0
-    with open(path, 'rb') as f:
-        while True:
-            chunk = f.read(_CRC_CHUNK)
-            if not chunk:
-                break
-            crc = zlib.crc32(chunk, crc)
-            size += len(chunk)
-    return size, crc & 0xffffffff
+# (size, crc32) of a file, streamed — now owned by integrity.py (the
+# manifest triples and the integrity catalog must agree by
+# construction); the old name stays for handoff callers
+file_crc = mod_integrity.file_crc
 
 
 def _interval_trees(ds):
@@ -165,6 +155,95 @@ def _shard_timeformats(ds):
     for interval, root, timeformat in _interval_trees(ds):
         out[os.path.basename(root)] = timeformat
     return out
+
+
+# -- the shared fetch-and-land path -----------------------------------------
+#
+# One verified way for bytes to enter a tree over the wire: bounded
+# range fetches off the pooled connection, assembled into a
+# journal-style tmp (readers filter it, the recovery sweep
+# quarantines it if we die), crc-checked against the expected
+# (size, crc), fsynced, atomically renamed, and recorded in the
+# integrity catalog.  The handoff joiner (HandoffPuller) and the
+# self-healing repair path (serve/scrub.py) both ride it.
+
+def fetch_shard_range(endpoint, dsname, cfg_path, epoch, rel,
+                      offset, length, timeout_s):
+    """One `shard_fetch` exchange; returns the raw bytes or raises
+    DNError/OSError."""
+    from . import client as mod_client
+    req = {'op': 'shard_fetch', 'ds': dsname, 'config': cfg_path,
+           'epoch': epoch, 'rel': rel}
+    if length is not None:
+        req['offset'] = offset
+        req['length'] = length
+    rc, header, out, err = mod_client.request_bytes(
+        endpoint, req, timeout_s=timeout_s, retry=True)
+    if rc != 0:
+        raise DNError(err.decode('utf-8', 'replace').strip() or
+                      'shard_fetch failed')
+    return out
+
+
+def land_shard(endpoint, dsname, cfg_path, epoch, rel, size, crc,
+               dest, timeout_s, indexroot=None):
+    """Stream one shard from a donor into place: bounded range
+    fetches (FETCH_CHUNK_BYTES at a time — neither side ever buffers
+    a whole multi-GB shard) appended to a journal-style tmp, crc
+    verified over the assembled bytes, fsync, atomic rename, catalog
+    entry landed (when `indexroot` is given) so the fetched copy
+    verifies like a locally-published one."""
+    d = os.path.dirname(dest)
+    if d and not os.path.isdir(d):
+        os.makedirs(d, exist_ok=True)
+    tmp = dest + '.' + mod_journal.new_build_id()
+    try:
+        got_crc = 0
+        with open(tmp, 'wb') as f:
+            if size <= FETCH_CHUNK_BYTES:
+                data = fetch_shard_range(endpoint, dsname, cfg_path,
+                                         epoch, rel, 0, None,
+                                         timeout_s)
+                if len(data) != size:
+                    raise DNError(
+                        'shard "%s": %d bytes, expected %d '
+                        '(donor tree changed?)'
+                        % (rel, len(data), size))
+                got_crc = zlib.crc32(data)
+                f.write(data)
+            else:
+                written = 0
+                while written < size:
+                    want = min(FETCH_CHUNK_BYTES, size - written)
+                    data = fetch_shard_range(
+                        endpoint, dsname, cfg_path, epoch, rel,
+                        written, want, timeout_s)
+                    if len(data) != want:
+                        raise DNError(
+                            'shard "%s": short range at %d '
+                            '(donor tree changed?)' % (rel, written))
+                    got_crc = zlib.crc32(data, got_crc)
+                    f.write(data)
+                    written += want
+            f.flush()
+            os.fsync(f.fileno())
+        if (got_crc & 0xffffffff) != crc:
+            raise DNError(
+                'shard "%s": bytes do not match the expected crc '
+                '(donor tree changed?)' % rel)
+        mod_faults.fire('handoff.apply', torn_path=tmp)
+        os.rename(tmp, dest)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if indexroot is not None:
+        mod_integrity.update_catalog(
+            indexroot,
+            add={mod_integrity.shard_rel(indexroot, dest):
+                 (size, crc)})
 
 
 class HandoffPuller(object):
@@ -390,7 +469,8 @@ class HandoffPuller(object):
                     return missing
                 if self._fetch_shard(dsname, cfg_path, rel, size,
                                      crc, donors, dest,
-                                     timeout_s, retries):
+                                     timeout_s, retries,
+                                     ds.ds_indexpath):
                     streamed_any = True
                 else:
                     missing.append(rel)
@@ -410,10 +490,11 @@ class HandoffPuller(object):
         return missing
 
     def _fetch_shard(self, dsname, cfg_path, rel, size, crc, donors,
-                     dest, timeout_s, retries):
+                     dest, timeout_s, retries, indexroot):
         """One shard: fetch bytes from a donor (failing over), verify
-        size+crc, land via journal-style tmp + rename.  Returns
-        True on success."""
+        size+crc, land via the shared land_shard path (journal-style
+        tmp + crc + rename + catalog entry).  Returns True on
+        success."""
         if not donors:
             # locally-enumerated shard that somehow went missing
             # before the present-check: nobody to fetch it from
@@ -424,8 +505,10 @@ class HandoffPuller(object):
             donor = donors[attempt % len(donors)]
             try:
                 mod_faults.fire('handoff.fetch')
-                self._land_from(donor, dsname, cfg_path, rel, size,
-                                crc, dest, timeout_s)
+                land_shard(self.committed.endpoint(donor), dsname,
+                           cfg_path, self.committed.epoch, rel,
+                           size, crc, dest, timeout_s,
+                           indexroot=indexroot)
                 self._bump('shards_streamed')
                 self._bump('bytes_streamed', size)
                 obs_metrics.inc('handoff_shards_streamed_total')
@@ -438,76 +521,6 @@ class HandoffPuller(object):
                     self.log.warn('shard fetch failed', rel=rel,
                                   donor=donor, err=str(e))
         return False
-
-    def _fetch_range(self, donor, dsname, cfg_path, rel, offset,
-                     length, timeout_s):
-        req = {'op': 'shard_fetch', 'ds': dsname, 'config': cfg_path,
-               'epoch': self.committed.epoch, 'rel': rel}
-        if length is not None:
-            req['offset'] = offset
-            req['length'] = length
-        rc, header, out, err = self._request(
-            self.committed.endpoint(donor), req, timeout_s)
-        if rc != 0:
-            raise DNError(err.decode('utf-8', 'replace').strip() or
-                          'shard_fetch failed')
-        return out
-
-    def _land_from(self, donor, dsname, cfg_path, rel, size, crc,
-                   dest, timeout_s):
-        """Stream one shard from `donor` into place: bounded range
-        fetches (FETCH_CHUNK_BYTES at a time — neither side ever
-        buffers a whole multi-GB shard) appended to a journal-style
-        tmp (readers filter it; the recovery sweep quarantines it if
-        we die mid-write), crc verified over the assembled bytes,
-        fsync, atomic rename."""
-        d = os.path.dirname(dest)
-        if d and not os.path.isdir(d):
-            os.makedirs(d, exist_ok=True)
-        tmp = dest + '.' + mod_journal.new_build_id()
-        try:
-            got_crc = 0
-            with open(tmp, 'wb') as f:
-                if size <= FETCH_CHUNK_BYTES:
-                    data = self._fetch_range(donor, dsname, cfg_path,
-                                             rel, 0, None, timeout_s)
-                    if len(data) != size:
-                        raise DNError(
-                            'shard "%s" from "%s": %d bytes, '
-                            'manifest says %d (donor tree changed?)'
-                            % (rel, donor, len(data), size))
-                    got_crc = zlib.crc32(data)
-                    f.write(data)
-                else:
-                    written = 0
-                    while written < size:
-                        want = min(FETCH_CHUNK_BYTES,
-                                   size - written)
-                        data = self._fetch_range(
-                            donor, dsname, cfg_path, rel, written,
-                            want, timeout_s)
-                        if len(data) != want:
-                            raise DNError(
-                                'shard "%s" from "%s": short range '
-                                'at %d (donor tree changed?)'
-                                % (rel, donor, written))
-                        got_crc = zlib.crc32(data, got_crc)
-                        f.write(data)
-                        written += want
-                f.flush()
-                os.fsync(f.fileno())
-            if (got_crc & 0xffffffff) != crc:
-                raise DNError(
-                    'shard "%s" from "%s": bytes do not match the '
-                    'manifest (donor tree changed?)' % (rel, donor))
-            mod_faults.fire('handoff.apply', torn_path=tmp)
-            os.rename(tmp, dest)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
 
 
 # -- the rebalance planner --------------------------------------------------

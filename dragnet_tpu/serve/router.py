@@ -242,6 +242,21 @@ def partial_query(ds, query, interval, topology, partition_ids):
     paths = [p for p in paths
              if topology.partition_of(p, timeformat) in want]
     mod_vpipe.counter_bump('cluster partial shards', len(paths))
+    # verified reads: a catalogued shard of OUR partitions missing
+    # from the walk (quarantined post-corruption, not yet repaired)
+    # rejects the partial retryably — the router fails over to a
+    # replica that has the bytes, instead of this member silently
+    # merging a short shard set
+    from .. import integrity as mod_integrity
+    if mod_integrity.verify_mode() != 'off':
+        mod_integrity.check_missing(
+            ds.ds_indexpath, paths,
+            subdir=os.path.basename(root)
+            if timeformat is not None else None,
+            timeformat=timeformat, after_ms=query.qc_after,
+            before_ms=query.qc_before,
+            partition_filter=lambda p:
+            topology.partition_of(p, timeformat) in want)
     indexroot = ds.ds_indexpath
     shards = []
     state = {'i': 0}
@@ -301,7 +316,8 @@ class Router(object):
                           'breaker_skips': 0,
                           'breaker_forced_dials': 0,
                           'epoch_updates': 0,
-                          'epoch_mismatches': 0}
+                          'epoch_mismatches': 0,
+                          'corrupt_failovers': 0}
         # the hedge-delay source: observed partial latencies (also
         # exported through the typed registry as router_partial_ms)
         self._latency = obs_metrics.Histogram()
@@ -542,6 +558,13 @@ class Router(object):
                 # a stale MAP from a dead member
                 e.epoch_mismatch = True
                 e.current_epoch = hstats.get('current_epoch')
+            if hstats.get('corrupt_shard'):
+                # the member detected (or is missing) a corrupt
+                # shard: it is ALIVE and self-healing — the failover
+                # to the next replica is the whole contract (counted
+                # uniformly in _fetch_partition, which also sees the
+                # LOCAL partial's ShardIntegrityError)
+                e.corrupt_shard = hstats.get('corrupt_shard')
             raise e
         st.breaker.record_success()
         try:
@@ -637,6 +660,12 @@ class Router(object):
                     return value
                 if value is not None:
                     errors.append(value)
+                    if getattr(value, 'corrupt_shard', None) \
+                            is not None:
+                        # a replica rejected because its shard bytes
+                        # are damaged (it repairs itself meanwhile):
+                        # the failover below is working as designed
+                        self._bump('corrupt_failovers')
                 else:
                     skipped.append(name)
                 if nxt < len(ranked):

@@ -1,0 +1,372 @@
+"""Serve-side integrity: self-healing replica repair, the background
+scrub thread, and cluster anti-entropy.
+
+The read path (integrity.py) DETECTS damage — a verified read that
+fails quarantines the shard and rejects retryably, and the router
+fails the partial over to a replica that has the bytes.  This module
+closes the loop so detection becomes self-healing:
+
+* RepairManager: when a member's partial hits a corrupt (or
+  catalogued-but-missing) shard, the serve layer schedules it here.
+  A background worker re-fetches the good copy from a committed
+  co-replica over the pooled `shard_fetch` path — crc-verified
+  against THIS member's catalog entry, landed journal-style tmp +
+  rename (exactly the PR 11 joiner discipline, shared code:
+  rebalance.land_shard) — and the member serves the partition again
+  with byte-identical data.  Repair counters ride /stats
+  `integrity`.
+
+* ScrubThread (DN_SCRUB_INTERVAL_S > 0): periodically walks every
+  configured tree comparing bytes against the catalog at a bounded
+  read rate (DN_SCRUB_RATE_MB_S), quarantining mismatches and
+  scheduling their repair.
+
+* anti_entropy: in cluster mode the scrub additionally diffs this
+  member's shard set against co-replicas' `shard_manifest` answers
+  for every partition it owns, pulling what is missing outright
+  (shards this member lost entirely, including their catalog
+  entries).  A shard that matches OUR catalog but differs from a
+  donor's manifest is counted `diverged` and left alone — that is a
+  concurrent publish racing the scrub, not rot; the next pass sees
+  the settled trees.
+
+The `scrub` serve op (`dn scrub --remote SOCK`) runs one pass on
+demand under the server's tree read locks (an in-process build can
+never swap shards mid-scrub), returning the summary as JSON.
+"""
+
+import collections
+import os
+import threading
+
+from ..errors import DNError
+from .. import integrity as mod_integrity
+from ..obs import metrics as obs_metrics
+from . import rebalance as mod_rebalance
+
+# the interval-tree layouts index_find_params produces: a manifest/
+# catalog relpath maps back to its assignment rule by its subdir
+TIMEFORMATS = {'by_day': '%Y-%m-%d.sqlite',
+               'by_hour': '%Y-%m-%d-%H.sqlite'}
+
+
+def rel_timeformat(rel):
+    head = rel.split('/')[0] if '/' in rel else rel
+    return TIMEFORMATS.get(head)
+
+
+class RepairManager(object):
+    """The damaged member's background self-repair queue.
+
+    schedule() is called from the request path (a corrupt detect must
+    not block the rejection riding back to the router) and from the
+    scrub; the worker drains one shard at a time.  Work is deduped by
+    (indexroot, rel) — a flood of partials hitting the same corrupt
+    shard schedules ONE repair."""
+
+    def __init__(self, server):
+        self.server = server
+        self._lock = threading.Lock()
+        self._pending = set()          # (indexroot, rel) queued/active
+        self._queue = collections.deque()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = None
+        self.counters = {'scheduled': 0, 'completed': 0,
+                         'failed': 0, 'no_donor': 0,
+                         'no_catalog': 0, 'bytes_repaired': 0}
+
+    def _bump(self, name, n=1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def stats(self):
+        with self._lock:
+            return dict(self.counters, queued=len(self._queue))
+
+    def schedule(self, dsname, indexroot, rels):
+        """Queue shards of `dsname`'s tree for repair (cluster mode
+        only — without replicas there is nothing to pull from)."""
+        if self.server.cluster is None or self.server.member is None:
+            return
+        started = False
+        with self._lock:
+            for rel in rels:
+                key = (os.path.abspath(indexroot), rel)
+                if key in self._pending:
+                    continue
+                self._pending.add(key)
+                self._queue.append((dsname, key[0], rel))
+                self.counters['scheduled'] += 1
+                started = True
+        if started:
+            self._wake.set()
+            self._ensure_thread()
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._run, name='dn-shard-repair', daemon=True)
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            with self._lock:
+                item = self._queue.popleft() if self._queue else None
+            if item is None:
+                self._wake.clear()
+                if self._wake.wait(5.0):
+                    continue
+                # idle timeout: retire ONLY if nothing raced in —
+                # a schedule() between our pop and this check saw a
+                # live thread and did not respawn, so returning with
+                # a non-empty queue (its keys already in _pending)
+                # would strand that shard unrepaired forever
+                with self._lock:
+                    if self._queue:
+                        continue
+                    self._thread = None   # next schedule respawns
+                return
+            dsname, indexroot, rel = item
+            try:
+                ok = self._repair_one(dsname, indexroot, rel)
+            except Exception as e:
+                ok = False
+                if self.server.log is not None:
+                    self.server.log.error('shard repair failed',
+                                          rel=rel, err=repr(e))
+            finally:
+                with self._lock:
+                    self._pending.discard((indexroot, rel))
+            if ok:
+                self._bump('completed')
+            else:
+                self._bump('failed')
+
+    def _repair_one(self, dsname, indexroot, rel):
+        """Pull one shard's good copy from a committed co-replica,
+        verified against OUR catalog entry (the byte-exact repair
+        target the publish recorded)."""
+        server = self.server
+        topo = server.cluster           # committed snapshot
+        if topo is None:
+            return False
+        expected = mod_integrity.load_catalog(indexroot).get(rel)
+        if expected is None:
+            self._bump('no_catalog')
+            return False
+        size, crc = expected
+        dest = os.path.join(indexroot, rel)
+        try:
+            if mod_integrity.file_crc(dest) == expected:
+                return True             # healed by another path
+        except OSError:
+            pass
+        pid = topo.partition_of(dest, rel_timeformat(rel))
+        donors = [m for m in topo.replicas(pid)
+                  if m != server.member]
+        if not donors:
+            self._bump('no_donor')
+            return False
+        timeout_s = server.topo_conf['handoff_timeout_s']
+        for donor in donors:
+            try:
+                mod_rebalance.land_shard(
+                    topo.endpoint(donor), dsname, None, topo.epoch,
+                    rel, size, crc, dest, timeout_s,
+                    indexroot=indexroot)
+            except (OSError, ValueError, DNError):
+                continue
+            from .. import index_query_mt as mod_iqmt
+            mod_iqmt.shard_cache_invalidate(dest)
+            self._bump('bytes_repaired', size)
+            obs_metrics.inc('integrity_repairs_total')
+            obs_metrics.inc('integrity_repair_bytes_total', size)
+            if server.log is not None:
+                server.log.info('shard repaired', rel=rel,
+                                donor=donor, bytes=size)
+            return True
+        return False
+
+
+# -- the scrub pass ----------------------------------------------------------
+
+def member_datasources(server):
+    """[(dsname, ds)] of file datasources with index trees under the
+    server's view of the world (its topology member config when
+    declared, the process default otherwise)."""
+    from .. import datasource_for_name
+    from .. import config as mod_config
+    cfg_path = None
+    if server.cluster is not None and server.member is not None:
+        cfg_path = server.cluster.member_config(server.member)
+    backend = mod_config.ConfigBackendLocal(cfg_path or None)
+    err, config = backend.load()
+    if err is not None and not getattr(err, 'is_enoent', False):
+        raise err
+    out = []
+    for dsname, dsdoc in config.datasource_list():
+        idx = (dsdoc.get('ds_backend_config') or {}).get('indexPath')
+        if not idx:
+            continue
+        ds = datasource_for_name(config, dsname)
+        if isinstance(ds, DNError):
+            continue
+        out.append((dsname, ds))
+    return out
+
+
+def anti_entropy(server, dsname, ds, repair=True):
+    """Diff this member's shard set against co-replicas' manifests
+    for every partition it owns; pull what is missing.  Returns
+    {'checked', 'pulled', 'diverged', 'unreachable'}."""
+    from . import client as mod_client
+    res = {'checked': 0, 'pulled': 0, 'diverged': 0,
+           'unreachable': 0}
+    topo = server.cluster
+    if topo is None or server.member is None:
+        return res
+    import json as mod_json
+    catalog = mod_integrity.load_catalog(ds.ds_indexpath)
+    timeout_s = server.topo_conf['handoff_timeout_s']
+    for pid in topo.partitions_of(server.member):
+        donors = [m for m in topo.replicas(pid)
+                  if m != server.member]
+        got = None
+        used_donor = None
+        for donor in donors:
+            try:
+                rc, header, out, err = mod_client.request_bytes(
+                    topo.endpoint(donor),
+                    {'op': 'shard_manifest', 'ds': dsname,
+                     'epoch': topo.epoch, 'partitions': [pid]},
+                    timeout_s=timeout_s, retry=True, pooled=True)
+                if rc == 0:
+                    got = mod_json.loads(
+                        out.decode('utf-8'))['shards']
+                    used_donor = donor
+                    break
+            except (OSError, ValueError, KeyError, DNError):
+                pass
+        if got is None:
+            if donors:
+                res['unreachable'] += 1
+            continue
+        for rel, size, crc in got:
+            res['checked'] += 1
+            dest = mod_rebalance.safe_rel(ds.ds_indexpath, rel)
+            try:
+                have = mod_integrity.file_crc(dest)
+            except OSError:
+                have = None
+            if have == (size, crc):
+                continue
+            if have is not None and catalog.get(rel) == have:
+                # our bytes match OUR catalog: the trees diverged
+                # (a publish racing the scrub) — not rot, not ours
+                # to clobber
+                res['diverged'] += 1
+                continue
+            if not repair:
+                res['diverged'] += 1
+                continue
+            try:
+                mod_rebalance.land_shard(
+                    topo.endpoint(used_donor), dsname, None,
+                    topo.epoch, rel, size, crc, dest, timeout_s,
+                    indexroot=ds.ds_indexpath)
+            except (OSError, ValueError, DNError):
+                res['unreachable'] += 1
+                continue
+            from .. import index_query_mt as mod_iqmt
+            mod_iqmt.shard_cache_invalidate(dest)
+            res['pulled'] += 1
+            obs_metrics.inc('integrity_repairs_total')
+            obs_metrics.inc('integrity_repair_bytes_total', size)
+    return res
+
+
+def scrub_member(server, repair=True, rate_bytes_s=0,
+                 quarantine=True):
+    """One scrub pass over the server's trees (the `scrub` op and the
+    background thread): verify bytes against catalogs (tree
+    read-locked — an in-process build cannot swap shards mid-walk),
+    quarantine + schedule repair for mismatches, then run cluster
+    anti-entropy.  quarantine=False (`dn scrub --check --remote`)
+    reports without acting.  Returns the summary doc."""
+    doc = {'trees': {}, 'anti_entropy': {}}
+    for dsname, ds in member_datasources(server):
+        lock = server._tree_lock(ds, dsname)
+
+        def on_corrupt(rel, path, dsname=dsname, ds=ds):
+            if repair:
+                server.repair.schedule(dsname, ds.ds_indexpath,
+                                       [rel])
+
+        with lock.read():
+            res = mod_integrity.scrub_tree(
+                ds.ds_indexpath, quarantine=quarantine,
+                rate_bytes_s=rate_bytes_s, on_corrupt=on_corrupt)
+        if repair and res['missing_shards']:
+            server.repair.schedule(dsname, ds.ds_indexpath,
+                                   res['missing_shards'])
+        doc['trees'][dsname] = res
+        if server.cluster is not None:
+            doc['anti_entropy'][dsname] = anti_entropy(
+                server, dsname, ds, repair=repair and quarantine)
+    return doc
+
+
+class ScrubThread(object):
+    """The background scrubber `dn serve` runs under
+    DN_SCRUB_INTERVAL_S > 0: one scrub_member pass per interval,
+    rate-limited reads, last-pass summary in /stats `integrity`."""
+
+    def __init__(self, server, interval_s, rate_bytes_s, log=None):
+        self.server = server
+        self.interval_s = interval_s
+        self.rate_bytes_s = rate_bytes_s
+        self.log = log
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.runs = 0
+        self.last = None
+        self.last_error = None
+        self._thread = threading.Thread(
+            target=self._run, name='dn-scrub', daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def stats(self):
+        with self._lock:
+            return {'interval_s': self.interval_s,
+                    'rate_bytes_s': self.rate_bytes_s,
+                    'runs': self.runs, 'last': self.last,
+                    'last_error': self.last_error}
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                doc = scrub_member(self.server, repair=True,
+                                   rate_bytes_s=self.rate_bytes_s)
+                with self._lock:
+                    self.runs += 1
+                    self.last = doc
+                    self.last_error = None
+                obs_metrics.inc('integrity_scrub_runs_total')
+            except Exception as e:
+                with self._lock:
+                    self.last_error = repr(e)
+                if self.log is not None:
+                    self.log.error('scrub pass failed', err=repr(e))

@@ -62,6 +62,7 @@ import time
 from .. import cli as mod_cli
 from .. import config as mod_config
 from .. import faults as mod_faults
+from .. import integrity as mod_integrity
 from .. import vpipe as mod_vpipe
 from .. import index_query_mt as mod_iqmt
 from .. import log as mod_log
@@ -289,6 +290,17 @@ class DnServer(object):
         self.host = host
         self.pidfile = pidfile
         self.bound_port = None
+        # shard integrity (integrity.py, serve/scrub.py): verified
+        # reads quarantine + reject retryably; the repair manager
+        # pulls good copies from co-replicas in the background; the
+        # scrub thread (DN_SCRUB_INTERVAL_S) sweeps proactively
+        integ_conf = mod_config.integrity_config()
+        if isinstance(integ_conf, DNError):
+            raise integ_conf
+        self.integrity_conf = integ_conf
+        from . import scrub as mod_scrub
+        self.repair = mod_scrub.RepairManager(self)
+        self.scrubber = None
         self.admission = mod_admission.Admission(
             conf['max_inflight'], conf['queue_depth'],
             tenant_quota=conf['tenant_quota'],
@@ -376,6 +388,12 @@ class DnServer(object):
                     self, self.cluster.path,
                     self.topo_conf['poll_ms'],
                     log=self.log).start()
+        if self.integrity_conf['scrub_interval_s'] > 0:
+            from . import scrub as mod_scrub
+            self.scrubber = mod_scrub.ScrubThread(
+                self, self.integrity_conf['scrub_interval_s'],
+                self.integrity_conf['scrub_rate_mb_s'] << 20,
+                log=self.log).start()
         self.log.info('listening',
                       socket=self.socket_path, port=self.bound_port,
                       member=self.member,
@@ -434,6 +452,9 @@ class DnServer(object):
         self.loop.shutdown(max(1.0, deadline - time.monotonic() + 1))
         if self.topo_watcher is not None:
             self.topo_watcher.stop()
+        if self.scrubber is not None:
+            self.scrubber.stop()
+        self.repair.stop()
         if self.puller is not None:
             self.puller.stop()
         if self.router is not None:
@@ -657,6 +678,25 @@ class DnServer(object):
         with self._stats_lock:
             self._counters[name] = self._counters.get(name, 0) + n
 
+    def _quarantine_usage(self):
+        """The quarantine_bytes/quarantine_files gauges for /stats
+        `recovery`: `.dn_quarantine/` is moved-into by every
+        corrupt-detect and crash rollback and pruned only by `dn
+        quarantine clean` — a long-lived fault-heavy deployment needs
+        its growth VISIBLE."""
+        files = 0
+        total = 0
+        try:
+            from . import scrub as mod_scrub
+            for dsname, ds in mod_scrub.member_datasources(self):
+                q = mod_integrity.quarantine_stats(ds.ds_indexpath)
+                files += q['files']
+                total += q['bytes']
+        except Exception:
+            pass
+        obs_metrics.set_gauge('quarantine_bytes', float(total))
+        return {'quarantine_files': files, 'quarantine_bytes': total}
+
     def _bump_op(self, op):
         with self._stats_lock:
             self._counters['requests'] += 1
@@ -699,10 +739,30 @@ class DnServer(object):
             # telemetry (empty unless DN_FAULTS armed) and the
             # crash-recovery counters (index_journal)
             'faults': mod_faults.stats(),
-            'recovery': {k: counters.get(k, 0)
-                         for k in ('index recovery rollbacks',
-                                   'index recovery rollforwards',
-                                   'index tmps quarantined')},
+            'recovery': dict(
+                {k: counters.get(k, 0)
+                 for k in ('index recovery rollbacks',
+                           'index recovery rollforwards',
+                           'index tmps quarantined')},
+                **self._quarantine_usage()),
+            # shard-integrity observability: verify mode, verified/
+            # corrupt/unverified read counters, repair queue +
+            # outcomes, last background-scrub summary (integrity.py,
+            # serve/scrub.py)
+            'integrity': {
+                'verify': mod_integrity.verify_mode(),
+                'reads_verified':
+                counters.get('integrity reads verified', 0),
+                'reads_unverified':
+                counters.get('integrity reads unverified', 0),
+                'corrupt_shards':
+                counters.get('integrity corrupt shards', 0),
+                'missing_shards':
+                counters.get('integrity missing shards', 0),
+                'repair': self.repair.stats(),
+                'scrub': self.scrubber.stats()
+                if self.scrubber is not None else None,
+            },
             # the typed registry (obs/metrics.py): versioned so
             # dashboards can gate on shape; histograms carry
             # p50/p90/p99 and cumulative buckets
@@ -928,6 +988,26 @@ class DnServer(object):
             body = obs_export.prometheus_text(
                 counters=mod_vpipe.global_counters())
             return 0, body.encode(), b'', {}
+        if op == 'scrub':
+            # one on-demand integrity pass (`dn scrub --remote`):
+            # verify every configured tree against its catalog under
+            # the tree read locks, quarantine + schedule repair for
+            # mismatches, run cluster anti-entropy.  Control plane:
+            # no admission slot (like shard_manifest — a scrub must
+            # not starve behind a query flood).
+            from . import scrub as mod_scrub
+            try:
+                doc = mod_scrub.scrub_member(
+                    self, repair=bool(req.get('repair', True)),
+                    rate_bytes_s=self.integrity_conf[
+                        'scrub_rate_mb_s'] << 20,
+                    quarantine=not req.get('check'))
+            except DNError as e:
+                self._bump('errors')
+                return (1, b'',
+                        ('dn: %s\n' % e.message).encode(), {})
+            body = json.dumps(doc, sort_keys=True, indent=2) + '\n'
+            return 0, body.encode(), b'', {}
         if op == 'build' and req.get('idempotency'):
             return self._execute_idempotent(req['idempotency'], req,
                                             tenant, deadline_at)
@@ -1074,6 +1154,24 @@ class DnServer(object):
                         flags['epoch_mismatch'] = True
                         flags['current_epoch'] = \
                             getattr(e, 'current_epoch', None)
+                    iroot = getattr(e, 'integrity_root', None)
+                    if iroot is not None:
+                        # a verified read detected corruption (or a
+                        # catalogued shard is missing): the header
+                        # names it so the router classifies the
+                        # rejection, and the damaged member starts
+                        # repairing itself in the background — the
+                        # self-healing contract
+                        flags['corrupt_shard'] = \
+                            getattr(e, 'corrupt_shard', None)
+                        shards = getattr(e, 'integrity_shards',
+                                         None) or []
+                        if shards:
+                            try:
+                                self.repair.schedule(
+                                    req.get('ds'), iroot, shards)
+                            except Exception:
+                                pass
                     if getattr(e, 'retryable', False):
                         flags['retryable_error'] = True
                         # degraded-because-shedding: the members'
@@ -1209,6 +1307,11 @@ class DnServer(object):
             extra['epoch_mismatch'] = True
             if flags.get('current_epoch') is not None:
                 extra['current_epoch'] = flags['current_epoch']
+        if flags.get('corrupt_shard') is not None:
+            # the self-healing signal: this member quarantined (or is
+            # missing) the named shard and is repairing in the
+            # background; the router fails the partial over meanwhile
+            extra['corrupt_shard'] = flags['corrupt_shard']
         return rc, out, err, finish_obs(rc, extra)
 
     def _tree_lock(self, ds, dsname):
@@ -1320,6 +1423,12 @@ class DnServer(object):
                 mod_admission.DeadlineError):
             raise
         except DNError as e:
+            if getattr(e, 'retryable', False):
+                # integrity (and other retryable) rejections keep
+                # their attributes: the job() handler frames the
+                # message AND marks the header (retryable,
+                # corrupt_shard) — fatal() would strip both
+                raise
             mod_cli.fatal(e)
         flags['coalesced'] = shared
         # coalesced requests demux through private clones: the output
@@ -1436,6 +1545,12 @@ class DnServer(object):
                 mod_admission.DeadlineError):
             raise
         except DNError as e:
+            if getattr(e, 'retryable', False):
+                # a corrupt-detect (ShardIntegrityError) must reach
+                # the job() handler with its attributes intact: the
+                # router reads the corrupt_shard header to classify
+                # the failover, and the repair schedule hangs off it
+                raise
             mod_cli.fatal(e)
         flags['coalesced'] = shared
         body = json.dumps({'epoch': serving.epoch,
@@ -1548,6 +1663,19 @@ class DnServer(object):
             with self._tree_lock(ds, dsname).read():
                 return mod_router.partial_query(
                     ds, query, interval, serving, partition_ids)
+        except DNError as e:
+            # a corrupt/missing detect on OUR OWN partial propagates
+            # to the router (which fails over to a replica), not
+            # through the request error handler — so the self-repair
+            # schedule hooks in right here
+            iroot = getattr(e, 'integrity_root', None)
+            shards = getattr(e, 'integrity_shards', None) or []
+            if iroot is not None and shards:
+                try:
+                    self.repair.schedule(dsname, iroot, shards)
+                except Exception:
+                    pass
+            raise
         finally:
             slot.release()
 

@@ -319,6 +319,37 @@ def test_topo_config_rejects_bad_values():
         assert str(err).startswith(list(env)[0]), env
 
 
+def test_integrity_config_defaults():
+    conf = mod_config.integrity_config(env={})
+    assert conf == {'verify': 'off', 'scrub_interval_s': 0,
+                    'scrub_rate_mb_s': 64}
+
+
+def test_integrity_config_parses_overrides():
+    conf = mod_config.integrity_config(env={
+        'DN_VERIFY': 'full',
+        'DN_SCRUB_INTERVAL_S': '300',
+        'DN_SCRUB_RATE_MB_S': '0'})
+    assert conf == {'verify': 'full', 'scrub_interval_s': 300,
+                    'scrub_rate_mb_s': 0}
+
+
+def test_integrity_config_rejects_bad_values():
+    for env in ({'DN_VERIFY': 'maybe'},
+                {'DN_SCRUB_INTERVAL_S': 'x'},
+                {'DN_SCRUB_INTERVAL_S': '-1'},
+                {'DN_SCRUB_RATE_MB_S': '-5'}):
+        err = mod_config.integrity_config(env=env)
+        assert isinstance(err, DNError), env
+        assert str(err).startswith(list(env)[0]), env
+
+
+def test_faults_config_accepts_flip_kind():
+    conf = mod_config.faults_config(
+        env={'DN_FAULTS': 'sink.rename:flip:0.5:9'})
+    assert conf['sites'] == {'sink.rename': ('flip', 0.5, 9)}
+
+
 def test_follow_config_defaults():
     conf = mod_config.follow_config(env={})
     assert conf == {'latency_ms': 500, 'max_bytes': 4 << 20,

@@ -189,8 +189,12 @@ def _no_litter(idx):
     for r, dirs, names in os.walk(idx):
         if mod_journal.QUARANTINE_DIR in dirs:
             dirs.remove(mod_journal.QUARANTINE_DIR)
+        # the committed integrity catalog (+ its flock sidecar) is
+        # durable tree metadata (readers filter it from shard walks,
+        # but it is not litter); its orphaned `.tmp`s still are
         bad.extend(os.path.join(r, n) for n in names
-                   if mod_journal.is_index_litter(n))
+                   if mod_journal.is_index_litter(n)
+                   and not mod_journal.is_durable_metadata(n))
     return bad
 
 

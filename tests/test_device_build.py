@@ -125,7 +125,12 @@ def test_stacked_build_byte_identical(tmp_path, monkeypatch):
     host_tree = _tree_bytes(tmp_path / 'ih')
     dev_tree = _tree_bytes(tmp_path / 'id')
     assert host_tree.keys() == dev_tree.keys()
-    assert len(host_tree) == 3    # three daily shards
+    # three daily shards plus integrity metadata (the catalog —
+    # itself compared byte-for-byte in the loop below — and its
+    # flock sidecar)
+    from dragnet_tpu import index_journal as mod_journal
+    assert len([p for p in host_tree
+                if not mod_journal.is_durable_metadata(p)]) == 3
     for rel in host_tree:
         assert host_tree[rel] == dev_tree[rel], \
             'index shard %s differs between stacked-device and host ' \

@@ -125,8 +125,12 @@ def _no_litter(idx):
         for skip in (mod_journal.FOLLOW_DIR, mod_journal.QUARANTINE_DIR):
             if skip in dirs:
                 dirs.remove(skip)
+        # the committed integrity catalog (+ its flock sidecar) is
+        # durable tree metadata, not litter (its orphaned `.tmp`s
+        # still are)
         bad.extend(os.path.join(r, n) for n in names
-                   if mod_journal.is_index_litter(n))
+                   if mod_journal.is_index_litter(n)
+                   and not mod_journal.is_durable_metadata(n))
     return bad
 
 

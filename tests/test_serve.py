@@ -709,8 +709,16 @@ def test_stats_reports_faults_and_recovery(server, corpus):
     assert 'faults' in st
     assert set(st['recovery']) == {'index recovery rollbacks',
                                    'index recovery rollforwards',
-                                   'index tmps quarantined'}
+                                   'index tmps quarantined',
+                                   'quarantine_files',
+                                   'quarantine_bytes'}
     assert st['draining'] is False
+    # the shard-integrity section (integrity.py, serve/scrub.py)
+    integ = st['integrity']
+    assert integ['verify'] in ('off', 'open', 'full')
+    assert isinstance(integ['repair'], dict)
+    assert {'scheduled', 'completed', 'failed'} <= set(
+        integ['repair'])
 
 
 # -- lifecycle hygiene -----------------------------------------------------
@@ -817,7 +825,9 @@ def test_serve_validate_ok(monkeypatch):
                    b'partial=error\n'
                    b'topo config ok: poll_ms=0 '
                    b'handoff_timeout_s=120 handoff_retries=2 '
-                   b'max_moves=2\n')
+                   b'max_moves=2\n'
+                   b'integrity config ok: verify=off '
+                   b'scrub_interval_s=0 scrub_rate_mb_s=64\n')
 
 
 def test_serve_validate_reports_armed_faults(monkeypatch):

@@ -168,13 +168,17 @@ def goldens(ctx):
 
 def tree_tmp_litter(idx):
     """Torn/orphaned tmp files anywhere in the tree OUTSIDE the
-    quarantine directory — the soak's zero-torn-shards invariant."""
+    quarantine directory — the soak's zero-torn-shards invariant.
+    The committed integrity catalog (+ its flock sidecar) is durable
+    tree metadata (readers filter it from shard walks, but it is not
+    litter); its orphaned `.tmp`s still are."""
     bad = []
     for r, dirs, names in os.walk(idx):
         if mod_journal.QUARANTINE_DIR in dirs:
             dirs.remove(mod_journal.QUARANTINE_DIR)
         for name in names:
-            if mod_journal.is_index_litter(name):
+            if mod_journal.is_index_litter(name) and \
+                    not mod_journal.is_durable_metadata(name):
                 bad.append(os.path.join(r, name))
     return bad
 
@@ -1438,6 +1442,313 @@ def soak_rebalance(root, fast=False, verbose=True, floor=None):
     return s.summary()
 
 
+# -- shard-integrity (scrub/repair) drill -----------------------------------
+
+
+class ScrubSoak(ClusterSoak):
+    """The corruption drill (`--scrub` / `make soak-scrub`): a
+    3-member cluster with PRIVATE byte-identical trees (topology
+    members[].config), DN_VERIFY=open and a 1-second background
+    scrub on every member.  The harness flips random bytes in
+    committed shards across all three trees (the rot the integrity
+    catalog exists to catch), floods routed queries, and asserts the
+    acceptance contract: every accepted result byte-identical to the
+    clean golden, every failure a clean retryable/degraded `dn:`
+    error, and every injected corruption eventually repaired from a
+    co-replica — byte-identity restored, verified against the
+    catalog the donor's copy still satisfies.  Zero silently wrong
+    result bytes."""
+
+    def __init__(self, ctx, verbose=True):
+        super(ScrubSoak, self).__init__(ctx, verbose=verbose)
+        self.member_rc = {}
+        self.flips = []          # (member, abspath, rel, (size, crc))
+        self.flip_rng = None
+        # each (dsname, rel) is corrupted on at most ONE member:
+        # repair pulls from a committed co-replica, so flipping the
+        # same shard on every replica of its partition manufactures
+        # unrepairable loss — a real deployment's replicas fail
+        # independently, and that independence is the redundancy the
+        # integrity model explicitly leans on (docs/robustness.md)
+        self._flipped_keys = set()
+
+    def write_member_rc(self, name):
+        """A member's private config: the shared datasources
+        re-pointed at per-member COPIES of the built trees."""
+        import shutil
+        with open(self.ctx['rc_path'], 'r') as f:
+            doc = json.load(f)
+        for ds in doc.get('datasources', []):
+            bc = ds.get('backend_config') or {}
+            if bc.get('indexPath'):
+                dst = os.path.join(
+                    self.ctx['root'],
+                    'idx_%s_%s' % (ds['name'], name))
+                shutil.copytree(bc['indexPath'], dst)
+                bc['indexPath'] = dst
+        path = os.path.join(self.ctx['root'], 'rc_%s.json' % name)
+        with open(path, 'w') as f:
+            json.dump(doc, f)
+        self.member_rc[name] = path
+        return path
+
+    def start_cluster(self):
+        root = self.ctx['root']
+        self.socks = {m: os.path.join(root, 'dn-%s.sock' % m)
+                      for m in 'abc'}
+        self.topo_path = os.path.join(root, 'topo.json')
+        for m in 'abc':
+            self.write_member_rc(m)
+        with open(self.topo_path, 'w') as f:
+            json.dump({
+                'epoch': 1, 'assign': 'hash',
+                'members': {m: {'endpoint': self.socks[m],
+                                'config': self.member_rc[m]}
+                            for m in 'abc'},
+                'partitions': [
+                    {'id': 0, 'replicas': ['a', 'b']},
+                    {'id': 1, 'replicas': ['b', 'c']},
+                    {'id': 2, 'replicas': ['c', 'a']},
+                ],
+            }, f)
+        from dragnet_tpu.serve import topology as mod_topology
+        conf = {'max_inflight': 8, 'queue_depth': 32,
+                'deadline_ms': 0, 'coalesce': True, 'drain_s': 10}
+        for m in 'ac':
+            topo = mod_topology.load_topology(self.topo_path,
+                                              member=m)
+            self.servers[m] = mod_server.DnServer(
+                socket_path=self.socks[m], conf=dict(conf),
+                cluster=topo, member=m).start()
+        self.spawn_b()
+
+    def member_trees(self, member):
+        """[(dsname, indexroot)] of one member's private trees."""
+        with open(self.member_rc[member]) as f:
+            doc = json.load(f)
+        return [(d['name'], d['backend_config']['indexPath'])
+                for d in doc['datasources']
+                if (d.get('backend_config') or {}).get('indexPath')]
+
+    def flip_round(self, per_member=2):
+        """XOR one byte in `per_member` randomly chosen committed
+        shards of every member's trees (deterministic RNG), recording
+        the catalog entry each must be restored to."""
+        from dragnet_tpu import integrity as mod_integrity
+        for member in 'abc':
+            trees = self.member_trees(member)
+            for k in range(per_member):
+                dsname = idx = rel = None
+                for attempt in range(32):
+                    dsname, idx = trees[self.flip_rng.randrange(
+                        len(trees))]
+                    catalog = mod_integrity.load_catalog(idx)
+                    rels = sorted(catalog)
+                    rel = rels[self.flip_rng.randrange(len(rels))]
+                    if (dsname, rel) not in self._flipped_keys:
+                        break
+                else:
+                    continue     # every candidate already in flight
+                self._flipped_keys.add((dsname, rel))
+                path = os.path.join(idx, rel)
+                try:
+                    size = os.path.getsize(path)
+                    off = self.flip_rng.randrange(size)
+                    mask = self.flip_rng.randrange(1, 256)
+                    with open(path, 'r+b') as f:
+                        f.seek(off)
+                        byte = f.read(1)
+                        f.seek(off)
+                        f.write(bytes([byte[0] ^ mask]))
+                except OSError:
+                    continue     # already quarantined by a scrubber
+                self.flips.append((member, path, rel, catalog[rel]))
+        self.note('flipped %d shard bytes (total %d)'
+                  % (3 * per_member, len(self.flips)))
+
+    def wait_all_healed(self, timeout_s=120.0):
+        """Every flipped shard must return to its catalog bytes — the
+        repair path (read-detect or background scrub, pulling the
+        good copy from a committed co-replica) closes the loop."""
+        from dragnet_tpu import integrity as mod_integrity
+        deadline = time.time() + timeout_s
+        pending = list(self.flips)
+        while pending and time.time() < deadline:
+            still = []
+            for member, path, rel, expected in pending:
+                try:
+                    if mod_integrity.file_crc(path) == \
+                            tuple(expected):
+                        continue
+                except OSError:
+                    pass          # quarantined; repair not landed yet
+                still.append((member, path, rel, expected))
+            pending = still
+            if pending:
+                time.sleep(0.5)
+        for member, path, rel, expected in pending:
+            self.violate('corruption never repaired: member %s '
+                         'shard %s' % (member, rel))
+        self.note('%d/%d corruptions repaired byte-identical'
+                  % (len(self.flips) - len(pending),
+                     len(self.flips)))
+        return not pending
+
+    def scrub_remote_clean(self, member):
+        got = run_cli(['scrub', '--remote', self.socks[member]])
+        rc, out, err = got
+        self.ops += 1
+        if rc != 0:
+            self.violate('dn scrub --remote %s reported diffs on a '
+                         'healed cluster: %s'
+                         % (member, out.decode('utf-8',
+                                               'replace')[:400]))
+            return
+        doc = json.loads(out.decode('utf-8'))
+        for dsname, t in (doc.get('trees') or {}).items():
+            if t.get('corrupt') or t.get('missing'):
+                self.violate('member %s tree %s not clean after '
+                             'repair: %s' % (member, dsname,
+                                             json.dumps(t)))
+
+
+def soak_scrub(root, fast=False, verbose=True, floor=None):
+    """The corruption/self-healing drill under `root`; returns the
+    summary dict."""
+    import random
+    mod_faults.reset()
+    from dragnet_tpu import integrity as mod_integrity
+    ctx = make_corpus(root, n=400 if fast else 1200,
+                      days=5 if fast else 10)
+    for fmt in FORMATS:
+        build(ctx, fmt)
+    os.environ.update({
+        'DN_ROUTER_PROBE_MS': '200', 'DN_ROUTER_FAILURES': '3',
+        'DN_ROUTER_COOLDOWN_MS': '500', 'DN_ROUTER_HEDGE_MS': '0',
+        'DN_ROUTER_FETCH_TIMEOUT_S': '30',
+        'DN_REMOTE_RETRIES': '3', 'DN_REMOTE_BACKOFF_MS': '10',
+        'DN_REMOTE_CONNECT_TIMEOUT_S': '5',
+        'DN_SERVE_CLIENT_TIMEOUT_S': '60',
+        'DN_VERIFY': 'open', 'DN_SCRUB_INTERVAL_S': '1',
+        'DN_SCRUB_RATE_MB_S': '0'})
+    mod_integrity.reset_memo()
+    s = ScrubSoak(ctx, verbose=verbose)
+    s.flip_rng = random.Random(1234)
+    s.start_cluster()
+    try:
+        s.note('fault-free routed byte-identity round '
+               '(verify=open)')
+        s.routed_rounds('', 1, degraded_ok=False)
+
+        # -- corruption flood: flip committed bytes across all three
+        # members' private trees, keep routed traffic flowing, and
+        # demand byte-identical-or-clean on every single response
+        flood_rounds = 4 if fast else 13
+        for burst in range(2 if fast else 3):
+            s.flip_round(per_member=1 if fast else 2)
+            from dragnet_tpu import index_query_mt as mod_iqmt
+            mod_iqmt.shard_cache_clear()   # the rot must be SEEN
+            s.routed_rounds('', flood_rounds, degraded_ok=True)
+        s.wait_all_healed(timeout_s=90 if fast else 180)
+
+        # -- post-heal: byte identity restored on every router, and
+        # an on-demand remote scrub reports zero diffs
+        s.routed_rounds('', 2 if fast else 4, degraded_ok=False)
+        for member in 'ac':
+            s.scrub_remote_clean(member)
+
+        # -- single-process leg: the flip FAULT KIND corrupts a
+        # publish in flight (checksums rode the commit record first);
+        # verified reads surface every one as a clean error, the
+        # scrub quarantines the rest, `dn quarantine` prunes, and a
+        # clean rebuild restores golden bytes
+        s.note('single-process flip-fault leg')
+        for fmt in FORMATS:
+            ds = ctx['ds'][fmt]
+            idx = ctx['idx'][fmt]
+            rc, out, err = run_cli(
+                ['build', ds],
+                env={'DN_INDEX_FORMAT': fmt,
+                     'DN_FAULTS': 'sink.rename:flip:0.6:21'})
+            s.ops += 1
+            if rc != 0:
+                s.violate('%s: flip-armed build failed: %r'
+                          % (fmt, err[-200:]))
+            mod_faults.reset()
+            from dragnet_tpu import index_query_mt as mod_iqmt
+            mod_iqmt.shard_cache_clear()
+            mod_integrity.reset_memo()
+            got = run_cli(['query', '-b', 'host', ds],
+                          env={'DN_INDEX_FORMAT': fmt})
+            s.ops += 1
+            rc, out, err = got
+            text = err.decode('utf-8', 'replace')
+            if rc == 0:
+                # the draws may have spared every shard this build —
+                # then bytes must equal the golden exactly
+                gold = s.golden[(fmt, ('query', '-b', 'host', ds))]
+                if out != gold[1]:
+                    s.violate('%s: silently wrong bytes from a '
+                              'flip-corrupted tree' % fmt)
+            elif 'Traceback' in text or 'dn:' not in text:
+                s.violate('%s: unclean corrupt-detect: %r'
+                          % (fmt, text[-300:]))
+            else:
+                s.clean_errors += 1
+            rc, out, err = run_cli(['scrub', '--tree', idx])
+            s.ops += 1
+            rc, out, err = run_cli(['scrub', '--tree', idx,
+                                    '--forget-missing'])
+            s.ops += 1
+            rc, out, err = run_cli(['quarantine', 'clean',
+                                    '--tree', idx])
+            s.ops += 1
+            if rc != 0:
+                s.violate('%s: quarantine clean failed: %r'
+                          % (fmt, err[-200:]))
+            # clean rebuild: golden bytes and a clean scrub again
+            build(ctx, fmt)
+            mod_iqmt.shard_cache_clear()
+            mod_integrity.reset_memo()
+            got = run_cli(['query', '-b', 'host', ds],
+                          env={'DN_INDEX_FORMAT': fmt})
+            s.check_result(fmt, ['query', '-b', 'host', ds], got)
+            rc, out, err = run_cli(['scrub', '--tree', idx])
+            s.ops += 1
+            if rc != 0:
+                s.violate('%s: rebuilt tree not scrub-clean: %s'
+                          % (fmt, out.decode('utf-8',
+                                             'replace')[:300]))
+        if floor:
+            extra = 0
+            while extra < 60:
+                total = mod_vpipe.global_counters().get(
+                    'faults injected', 0)
+                if total >= floor:
+                    break
+                extra += 1
+                s.note('top-up flip build %d (%d/%d faults)'
+                       % (extra, total, floor))
+                rc, out, err = run_cli(
+                    ['build', ctx['ds'][FORMATS[0]]],
+                    env={'DN_INDEX_FORMAT': FORMATS[0],
+                         'DN_FAULTS':
+                         'sink.rename:flip:1.0:%d' % (100 + extra)})
+                s.ops += 1
+                mod_faults.reset()
+            # leave the shared tree clean for the record
+            build(ctx, FORMATS[0])
+    finally:
+        for k in ('DN_VERIFY', 'DN_SCRUB_INTERVAL_S',
+                  'DN_SCRUB_RATE_MB_S'):
+            os.environ.pop(k, None)
+        mod_integrity.reset_memo()
+        s.stop_cluster()
+    summary = s.summary()
+    summary['corruptions_injected'] = len(s.flips)
+    return summary
+
+
 # -- continuous-ingest (dn follow) drill ------------------------------------
 
 # the appender: grows the log in fsynced bursts so the follower's
@@ -1888,6 +2199,14 @@ def main(argv=None):
                         'handoff/topology faults and mid-handoff '
                         'SIGKILLs) instead of the single-process '
                         'soak')
+    p.add_argument('--scrub', action='store_true',
+                   help='run the corruption/self-healing drill '
+                        '(flip bytes in committed shards across a '
+                        '3-member cluster under routed flood with '
+                        'DN_VERIFY=open and a 1s background scrub; '
+                        'assert zero silently wrong bytes and every '
+                        'corruption repaired from a co-replica) '
+                        'instead of the single-process soak')
     p.add_argument('--min-faults', type=int, default=None,
                    help='required injected-fault floor '
                         '(default: 500, or 50 with --fast; the '
@@ -1901,6 +2220,8 @@ def main(argv=None):
         default_floor = 15 if args.fast else 60
     elif args.rebalance:
         default_floor = 10 if args.fast else 40
+    elif args.scrub:
+        default_floor = 4 if args.fast else 10
     else:
         default_floor = 50 if args.fast else 500
     floor = args.min_faults if args.min_faults is not None \
@@ -1911,7 +2232,8 @@ def main(argv=None):
     runner = soak_cluster if args.cluster \
         else soak_follow if args.follow \
         else soak_overload if args.overload \
-        else soak_rebalance if args.rebalance else soak
+        else soak_rebalance if args.rebalance \
+        else soak_scrub if args.scrub else soak
     with tempfile.TemporaryDirectory(prefix='dn_soak_') as root:
         summary = runner(root, fast=args.fast, floor=floor)
     summary['elapsed_s'] = round(time.time() - t0, 1)
