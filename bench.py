@@ -964,6 +964,36 @@ def serve_bench(tmpdir):
         proc.send_signal(signal.SIGTERM)
         drained = proc.wait(timeout=60) == 0 and \
             not os.path.exists(sock)
+
+        # history-snapshotter overhead: the same warm workload with
+        # DN_METRICS_HISTORY_S=1s, proving the off path above is free
+        # (it ran with the rings disabled) and the on path is honest
+        hist_p50 = hist_p95 = None
+        hist_env = dict(env, DN_METRICS_HISTORY_S='1')
+        proc = subprocess.Popen([sys.executable, dn, 'serve',
+                                 '--socket', sock], env=hist_env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 60
+        while not mod_lc.probe(socket_path=sock):
+            if time.monotonic() > deadline or proc.poll() is not None:
+                raise RuntimeError('history-armed serve daemon '
+                                   'failed to start')
+            time.sleep(0.1)
+        rc0, _, hist_out, _ = mod_scl.request_bytes(sock, req)
+        assert rc0 == 0
+        hist_times = []
+        for _ in range(warm_reps):
+            t0 = time.monotonic()
+            rc0, _, hist_out, _ = mod_scl.request_bytes(sock, req)
+            hist_times.append((time.monotonic() - t0) * 1000)
+            assert rc0 == 0
+        hist_p50, hist_p95 = pctl(hist_times)
+        hist_identical = hist_out == warm_out
+        hist_st = mod_scl.stats(sock)
+        hist_samples = (hist_st.get('history') or {}).get('samples')
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -1004,6 +1034,15 @@ def serve_bench(tmpdir):
         'serve_query_latency_p50_ms': qlat.get('p50'),
         'serve_query_latency_p99_ms': qlat.get('p99'),
         'serve_drained_clean': bool(drained),
+        # the history-snapshotter overhead pair: warm p50 with the
+        # rings off (the main leg above) vs DN_METRICS_HISTORY_S=1
+        'serve_history_off_warm_p50_ms': round(warm_p50, 2),
+        'serve_history_1s_warm_p50_ms': round(hist_p50, 2)
+        if hist_p50 is not None else None,
+        'serve_history_1s_warm_p95_ms': round(hist_p95, 2)
+        if hist_p95 is not None else None,
+        'serve_history_output_byte_identical': hist_identical,
+        'serve_history_samples': hist_samples,
     }
 
 

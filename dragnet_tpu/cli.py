@@ -103,6 +103,11 @@ DN_OPTIONS = [
     (['iq-stack'], 'string', None),
     (['index-path'], 'string', None),
     (['member'], 'string', None),
+    # `dn events --follow`: keep polling the remote journal and print
+    # new entries as they land (docs/observability.md).  Distinct
+    # from the `dn follow` SUBcommand.  Not in USAGE_TEXT
+    # (byte-pinned).
+    (['follow'], 'bool', None),
     # `dn follow` catch-up mode: ingest to the sources' current EOF,
     # publish, checkpoint, and exit instead of tailing forever.  Not
     # in USAGE_TEXT (byte-pinned); documented in docs/ingest.md.
@@ -897,14 +902,49 @@ def cmd_index_read(ctx, argv):
 
 
 def cmd_stats(ctx, argv):
-    """`dn stats [--remote SOCK|HOST:PORT] [--prom]`: render a
-    resident server's /stats document (or its Prometheus metrics
-    exposition with --prom); without --remote, this process's own
-    metrics registry — mostly interesting after an in-process run.
-    Not in USAGE_TEXT (byte-pinned); documented in
-    docs/observability.md."""
+    """`dn stats [--remote SOCK|HOST:PORT] [--prom] [--cluster]`:
+    render a resident server's /stats document (or its Prometheus
+    metrics exposition with --prom); without --remote, this process's
+    own metrics registry — mostly interesting after an in-process
+    run.  `--cluster` (a bare flag here, unlike `dn serve
+    --cluster=FILE`) asks the server for the MERGED fleet document
+    instead — any member aggregates every topology member's stats
+    over the pooled path, dead members reported unreachable
+    (serve/fleet.py); with --prom the fleet headline numbers render
+    as a synthesized dn_fleet_* exposition.  Not in USAGE_TEXT
+    (byte-pinned); documented in docs/observability.md."""
+    # --cluster is a bare flag for THIS command but a string option
+    # (topology path) for `dn serve`; the shared option table keys
+    # type by name, so strip it before the parse
+    argv = list(argv)
+    fleet = False
+    while '--cluster' in argv:
+        argv.remove('--cluster')
+        fleet = True
     opts = dn_parse_args(argv, ['remote', 'prom'])
     check_arg_count(opts, 0)
+    if fleet:
+        if not opts.remote:
+            fatal(DNError('"--cluster" requires "--remote" naming '
+                          'any cluster member'))
+        from .serve import client as mod_serve_client
+        from .serve import fleet as mod_fleet
+        import json as mod_json
+        try:
+            rc, header, out, err = mod_serve_client.request_bytes(
+                opts.remote, {'op': 'fleet_stats'}, timeout_s=60.0)
+        except (OSError, ValueError, DNError) as e:
+            fatal(DNError('serve endpoint "%s" unreachable'
+                          % opts.remote, cause=DNError(str(e))))
+        sys.stderr.write(err.decode('utf-8', 'replace'))
+        if rc != 0:
+            return rc
+        if getattr(opts, 'prom', None):
+            doc = mod_json.loads(out.decode('utf-8'))
+            sys.stdout.write(mod_fleet.fleet_prometheus_text(doc))
+        else:
+            sys.stdout.write(out.decode('utf-8', 'replace'))
+        return 0
     if opts.remote:
         from .serve import client as mod_serve_client
         op = 'metrics' if getattr(opts, 'prom', None) else 'stats'
@@ -934,6 +974,99 @@ def cmd_stats(ctx, argv):
     sys.stdout.write(mod_json.dumps(
         doc, sort_keys=True, indent=2) + '\n')
     return 0
+
+
+def cmd_events(ctx, argv):
+    """`dn events [--follow] [--remote SOCK|HOST:PORT]`: print the
+    structured event journal (obs/events.py) as one JSON line per
+    entry — failovers, breaker flips, epoch transitions, handoff
+    outcomes, repairs, quarantines, shed bursts, scrub summaries,
+    each with its trace id when one was active.  --remote reads a
+    resident server's journal through the `events` op; --follow
+    keeps polling and prints new entries as they land (the journal
+    must be armed with DN_EVENTS / DN_EVENTS_FILE on the server).
+    Without --remote, this process's own journal.  Not in USAGE_TEXT
+    (byte-pinned); documented in docs/observability.md."""
+    import json as mod_json
+    import time as mod_time
+    opts = dn_parse_args(argv, ['remote', 'follow'])
+    check_arg_count(opts, 0)
+    obs_conf = mod_config.obs_config()
+    if isinstance(obs_conf, DNError):
+        fatal(obs_conf)
+
+    def emit_lines(entries):
+        for e in entries:
+            sys.stdout.write(mod_json.dumps(
+                e, sort_keys=True, separators=(',', ':')) + '\n')
+        if entries:
+            sys.stdout.flush()
+
+    if not opts.remote:
+        from .obs import events as obs_events
+        j = obs_events.journal()
+        if j is None:
+            sys.stderr.write('dn: event journal disabled (set '
+                             'DN_EVENTS or DN_EVENTS_FILE)\n')
+            return 1
+        emit_lines(j.tail())
+        return 0
+
+    from .serve import client as mod_serve_client
+    since = 0
+    poll_s = max(0.1, obs_conf['top_interval_ms'] / 1000.0)
+    while True:
+        try:
+            rc, header, out, err = mod_serve_client.request_bytes(
+                opts.remote, {'op': 'events', 'since': since},
+                timeout_s=30.0)
+        except (OSError, ValueError, DNError) as e:
+            fatal(DNError('serve endpoint "%s" unreachable'
+                          % opts.remote, cause=DNError(str(e))))
+        if rc != 0:
+            sys.stderr.write(err.decode('utf-8', 'replace'))
+            return rc
+        doc = mod_json.loads(out.decode('utf-8'))
+        if not doc.get('enabled'):
+            sys.stderr.write('dn: event journal disabled on the '
+                             'server (set DN_EVENTS or '
+                             'DN_EVENTS_FILE)\n')
+            return 1
+        entries = doc.get('events') or []
+        emit_lines(entries)
+        since = max([doc.get('seq') or 0] +
+                    [e.get('seq') or 0 for e in entries])
+        if not getattr(opts, 'follow', None):
+            return 0
+        try:
+            mod_time.sleep(poll_s)
+        except KeyboardInterrupt:
+            return 0
+
+
+def cmd_top(ctx, argv):
+    """`dn top --remote SOCK|HOST:PORT [--once]`: the live fleet
+    console (serve/top.py) — polls `fleet_stats` at
+    DN_TOP_INTERVAL_MS and redraws the fleet header, per-member
+    table, and event tail in place.  Degrades to single-process mode
+    against a non-cluster server.  --once prints one frame with no
+    ANSI codes and exits.  Not in USAGE_TEXT (byte-pinned);
+    documented in docs/observability.md."""
+    opts = dn_parse_args(argv, ['remote', 'once'])
+    check_arg_count(opts, 0)
+    if not opts.remote:
+        raise UsageError('"--remote" is required for "top"')
+    obs_conf = mod_config.obs_config()
+    if isinstance(obs_conf, DNError):
+        fatal(obs_conf)
+    from .serve import top as mod_top
+    try:
+        return mod_top.top_main(opts.remote,
+                                obs_conf['top_interval_ms'],
+                                once=bool(getattr(opts, 'once',
+                                                  None)))
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_follow(ctx, argv):
@@ -1475,6 +1608,13 @@ def cmd_serve(ctx, argv):
                obs_conf['slow_ms'] if obs_conf['slow_ms'] is not None
                else 'off', len(obs_conf['buckets'])))
         sys.stdout.write(
+            'fleet obs ok: history_s=%d events=%d events_file=%s '
+            'top_interval_ms=%d fleet_timeout_s=%d\n'
+            % (obs_conf['history_s'], obs_conf['events'],
+               obs_conf['events_file'] or 'off',
+               obs_conf['top_interval_ms'],
+               conf['fleet_timeout_s']))
+        sys.stdout.write(
             'router config ok: probe_ms=%d failures=%d '
             'cooldown_ms=%d hedge_ms=%d fetch_timeout_s=%d '
             'partial=%s\n'
@@ -1541,6 +1681,7 @@ COMMANDS = {
     'metric-list': cmd_metric_list,
     'metric-remove': cmd_metric_remove,
     'build': cmd_build,
+    'events': cmd_events,
     'follow': cmd_follow,
     'index-config': cmd_index_config,
     'index-read': cmd_index_read,
@@ -1551,6 +1692,7 @@ COMMANDS = {
     'scrub': cmd_scrub,
     'serve': cmd_serve,
     'stats': cmd_stats,
+    'top': cmd_top,
     'topo': cmd_topo,
 }
 

@@ -339,6 +339,10 @@ _SERVE_KNOBS = [
     # fair-dequeue weight for tenants not named in
     # DN_SERVE_TENANT_WEIGHTS
     ('DN_SERVE_TENANT_DEFAULT_WEIGHT', 'int', 1, 1),
+    # per-member fetch bound for the fleet_stats scatter
+    # (serve/fleet.py): a dead member costs the fleet view at most
+    # this long and shows up as unreachable, never a hang
+    ('DN_SERVE_FLEET_TIMEOUT_S', 'int', 5, 1),
 ]
 
 
@@ -370,8 +374,8 @@ def serve_config(env=None):
     """The resolved DN_SERVE_* knob dict (keys: max_inflight,
     queue_depth, deadline_ms, coalesce, drain_s, read_deadline_ms,
     write_deadline_ms, idle_ms, tenant_quota, tenant_default_weight,
-    tenant_weights), or DNError on the first malformed value —
-    'DN_SERVE_X: expected ..., got "v"'."""
+    tenant_weights, fleet_timeout_s), or DNError on the first
+    malformed value — 'DN_SERVE_X: expected ..., got "v"'."""
     if env is None:
         env = os.environ
     rv = {}
@@ -681,7 +685,8 @@ def integrity_config(env=None):
 
 def obs_config(env=None):
     """The resolved observability knobs (keys: trace, slow_ms,
-    buckets), or DNError on the first malformed value.
+    buckets, history_s, events, events_file, top_interval_ms), or
+    DNError on the first malformed value.
 
     * DN_TRACE: '' (off), 'stderr', or a trace-file path (one JSON
       span-tree line per request is appended).
@@ -689,6 +694,14 @@ def obs_config(env=None):
       their span tree to stderr.  Empty/unset disables.
     * DN_METRICS_BUCKETS: comma-separated strictly-increasing positive
       histogram upper bounds (ms); unset uses the default ladder.
+    * DN_METRICS_HISTORY_S: seconds between metric-history snapshots
+      (obs/history.py); 0 (the default) disables the rings.
+    * DN_EVENTS: event-journal ring capacity (obs/events.py); 0 (the
+      default) disables the journal.
+    * DN_EVENTS_FILE: optional JSONL spill path for the journal
+      (implies a default ring when DN_EVENTS is unset); its directory
+      must exist, like DN_TRACE's.
+    * DN_TOP_INTERVAL_MS: `dn top` poll cadence, integer >= 100.
     """
     if env is None:
         env = os.environ
@@ -712,6 +725,29 @@ def obs_config(env=None):
             return DNError('DN_SLOW_MS: expected an integer >= 0, '
                            'got "%s"' % raw)
         rv['slow_ms'] = slow
+    for name, key, default, minimum in (
+            ('DN_METRICS_HISTORY_S', 'history_s', 0, 0),
+            ('DN_EVENTS', 'events', 0, 0),
+            ('DN_TOP_INTERVAL_MS', 'top_interval_ms', 1000, 100)):
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            value = minimum - 1
+        if value < minimum:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        rv[key] = value
+    evfile = env.get('DN_EVENTS_FILE') or ''
+    if evfile:
+        parent = os.path.dirname(os.path.abspath(evfile))
+        if not os.path.isdir(parent):
+            return DNError('DN_EVENTS_FILE: expected a path in an '
+                           'existing directory, got "%s"' % evfile)
+    rv['events_file'] = evfile or None
     raw = env.get('DN_METRICS_BUCKETS')
     if raw is None or raw == '':
         from .obs.metrics import DEFAULT_BUCKETS_MS

@@ -64,6 +64,11 @@ INTEGRITY_NAME = '.dn_integrity.json'
 # publishes through the SAME commit journal as the shards, so the
 # sweep treats its tmps like shard tmps
 FOLLOW_DIR = '.dn_follow'
+# the event journal's optional JSONL spill (obs/events.py,
+# DN_EVENTS_FILE): operators may point it inside an index tree —
+# readers must filter it from shard walks, and litter checkers must
+# not flag it as a torn artifact
+EVENTS_PREFIX = '.dn_events'
 
 # tmp names: `<shard>.<pid>` (legacy single-sink flushes) or
 # `<shard>.<pid>.<seq>` (journaled builds); shards are `all` or
@@ -97,17 +102,20 @@ def is_index_litter(name):
             base == QUARANTINE_DIR or
             base == FOLLOW_DIR or
             base.startswith(INTEGRITY_NAME) or
+            base.startswith(EVENTS_PREFIX) or
             _TMP_RE.match(base) is not None)
 
 
 def is_durable_metadata(name):
     """True for tree metadata that readers filter from shard walks
     but that is NOT litter: the committed integrity catalog and its
-    cross-process flock sidecar.  Litter checkers (the soaks' zero-
-    torn-shards invariant) exempt these; catalog `.tmp`s stay
-    litter."""
+    cross-process flock sidecar, and the event journal's JSONL spill
+    (append-only, fsync-free — never a torn shard).  Litter checkers
+    (the soaks' zero-torn-shards invariant) exempt these; catalog
+    `.tmp`s stay litter."""
     base = os.path.basename(name)
-    return base in (INTEGRITY_NAME, INTEGRITY_NAME + '.lock')
+    return base in (INTEGRITY_NAME, INTEGRITY_NAME + '.lock') or \
+        base.startswith(EVENTS_PREFIX)
 
 
 def _tmp_owner_pid(name):

@@ -315,10 +315,14 @@ def quarantine_corrupt(shard_path, detail):
     mod_journal._quarantine(root, shard_path)
     mod_iqmt.shard_cache_invalidate(shard_path)
     counter_bump('integrity corrupt shards')
+    from .obs import events as obs_events
     from .obs import metrics as obs_metrics
     from .obs import trace as obs_trace
     obs_metrics.inc('integrity_corrupt_shards_total')
     obs_trace.event('integrity.corrupt', shard=rel)
+    if obs_events.enabled():
+        obs_events.emit('integrity.quarantine', shard=rel,
+                        error=detail)
     raise ShardIntegrityError(
         'index "%s": shard integrity check failed (%s); shard '
         'quarantined' % (shard_path, detail),

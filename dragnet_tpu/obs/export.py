@@ -63,6 +63,32 @@ def stats_section(registry=None, counters=None):
     return doc
 
 
+def histogram_from_doc(ent):
+    """Re-hydrate a Histogram from the /stats JSON shape
+    stats_section renders (count/sum + CUMULATIVE buckets) — the
+    fleet aggregator's input: member histograms travel as their
+    /stats documents and merge through the existing Histogram.merge.
+    Returns None for a malformed document (a fleet view must degrade,
+    never crash, on one member's bad bytes)."""
+    try:
+        buckets = ent['buckets']
+        bounds = sorted(float(k) for k in buckets if k != '+Inf')
+        h = mod_metrics.Histogram(tuple(bounds))
+        cum = 0
+        for i, b in enumerate(bounds):
+            c = int(buckets['%g' % b])
+            h.counts[i] = c - cum
+            cum = c
+        h.total = int(ent['count'])
+        h.counts[len(bounds)] = h.total - cum
+        h.sum = float(ent['sum'])
+        if h.total < 0 or any(c < 0 for c in h.counts):
+            return None
+        return h
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
 def _prom_name(name):
     out = []
     for ch in name:

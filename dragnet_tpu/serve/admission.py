@@ -57,6 +57,7 @@ from contextlib import contextmanager
 
 from ..errors import DNError
 from .. import faults as mod_faults
+from ..obs import events as obs_events
 from ..obs import metrics as obs_metrics
 
 
@@ -335,6 +336,14 @@ class Admission(object):
                 t.counters['shed_overload'] += 1
                 self._shed_overload += 1
                 obs_metrics.inc('serve_shed_total', reason='overload')
+                if obs_events.enabled():
+                    # coalesced: a shed STORM is one journal entry
+                    # per window with the burst count, not a ring
+                    # flush of everything else
+                    obs_events.emit_burst('serve.shed',
+                                          key='overload',
+                                          reason='overload',
+                                          tenant=t.name)
                 raise OverloadedError(
                     'server overloaded: remaining deadline (%d ms) '
                     'below observed service time (%d ms); shed'
@@ -386,6 +395,12 @@ class Admission(object):
                                 self._shed_expired += 1
                                 obs_metrics.inc('serve_shed_total',
                                                 reason='expired')
+                                if obs_events.enabled():
+                                    obs_events.emit_burst(
+                                        'serve.shed',
+                                        key='expired',
+                                        reason='expired',
+                                        tenant=t.name)
                                 raise OverloadedError(
                                     'server overloaded: deadline '
                                     'expired while queued; shed',

@@ -253,6 +253,16 @@ def _exchange_with_retry(remote, req, timeout_s, on_header,
         % (attempts, detail))
 
 
+def graft_remote_trace(tctx, header):
+    """Graft the span subtree a server returned in its response
+    header (``stats.trace``) into `tctx` under the caller's current
+    span — the shared joined-tree seam for the `--remote` client AND
+    the router's pooled partial path."""
+    remote_doc = (header.get('stats') or {}).get('trace')
+    if remote_doc:
+        tctx.graft(remote_doc.get('spans') or remote_doc)
+
+
 def _write_bytes(stream, data):
     """Verbatim byte pass-through: the underlying binary buffer when
     the stream has one (flushing pending text first so ordering
@@ -292,9 +302,7 @@ def request(remote, req, timeout_s=None):
 
     def stream_through(header, f):
         if tctx is not None:
-            remote_doc = (header.get('stats') or {}).get('trace')
-            if remote_doc:
-                tctx.graft(remote_doc.get('spans') or remote_doc)
+            graft_remote_trace(tctx, header)
         for size, stream in ((header.get('nout', 0), sys.stdout),
                              (header.get('nerr', 0), sys.stderr)):
             for chunk in _read_exact(f, size):

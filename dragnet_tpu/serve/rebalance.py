@@ -47,6 +47,7 @@ from .. import config as mod_config
 from .. import faults as mod_faults
 from .. import index_journal as mod_journal
 from .. import integrity as mod_integrity
+from ..obs import events as obs_events
 from ..obs import metrics as obs_metrics
 
 # shards larger than this stream in bounded range-fetches instead of
@@ -332,9 +333,15 @@ class HandoffPuller(object):
                 self.ready = True
             obs_metrics.set_gauge('handoff_ready',
                                   1.0 if self.ready else 0.0)
+            obs_events.emit(
+                'handoff.ready' if self.ready else 'handoff.failed',
+                epoch=self.target_epoch, error=self.error,
+                partitions=sorted(self.affected_pids))
         except Exception as e:
             self.failed = True
             self.error = str(e)
+            obs_events.emit('handoff.failed', epoch=self.target_epoch,
+                            error=self.error)
             if self.log is not None:
                 self.log.error('handoff pull failed', err=repr(e))
         finally:

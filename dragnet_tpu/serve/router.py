@@ -69,6 +69,7 @@ from ..errors import DNError
 from .. import config as mod_config
 from .. import faults as mod_faults
 from .. import vpipe as mod_vpipe
+from ..obs import events as obs_events
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 
@@ -110,6 +111,18 @@ class _BreakerOpen(Exception):
     """Internal: a dial was suppressed by an open breaker."""
 
 
+# every router counter also lands in the typed registry as
+# ``router_<name>_total`` (_bump below); module-level so the
+# Prometheus-exposition completeness gate can enumerate the family
+# without constructing a Router
+COUNTER_NAMES = ('scatters', 'partials_local', 'partials_remote',
+                 'failovers', 'hedges_fired', 'hedges_won',
+                 'hedges_wasted', 'degraded', 'partial_responses',
+                 'breaker_skips', 'breaker_forced_dials',
+                 'epoch_updates', 'epoch_mismatches',
+                 'corrupt_failovers')
+
+
 # -- circuit breaker --------------------------------------------------------
 
 class Breaker(object):
@@ -121,11 +134,13 @@ class Breaker(object):
 
     CLOSED, OPEN, HALF_OPEN = 'closed', 'open', 'half-open'
 
-    def __init__(self, failures, cooldown_ms, clock=time.monotonic):
+    def __init__(self, failures, cooldown_ms, clock=time.monotonic,
+                 name=None):
         self._lock = threading.Lock()
         self._clock = clock
         self.failures_threshold = failures
         self.cooldown_s = cooldown_ms / 1000.0
+        self.name = name
         self.state = self.CLOSED
         self.consecutive_failures = 0
         self._opened_at = None
@@ -134,8 +149,14 @@ class Breaker(object):
                             self.HALF_OPEN: 0}
 
     def _to(self, state):
+        prior = self.state
         self.state = state
         self.transitions[state] += 1
+        if obs_events.enabled():
+            # probes flip breakers with no request active: no trace
+            obs_events.emit('breaker.' + state, member=self.name,
+                            prior=prior,
+                            failures=self.consecutive_failures)
 
     def allow(self):
         """May a request be sent to this member right now?"""
@@ -300,7 +321,8 @@ class Router(object):
         for name in topology.member_names():
             self.states[name] = MemberState(
                 name, topology.endpoint(name),
-                Breaker(conf['failures'], conf['cooldown_ms']))
+                Breaker(conf['failures'], conf['cooldown_ms'],
+                        name=name))
         self._stop = threading.Event()
         self._prober_started = False
         self._prober_threads = []
@@ -308,16 +330,7 @@ class Router(object):
         # never take it — they snapshot self.topo once per scatter
         self._swap_lock = threading.Lock()
         self._lock = threading.Lock()
-        self._counters = {'scatters': 0, 'partials_local': 0,
-                          'partials_remote': 0, 'failovers': 0,
-                          'hedges_fired': 0, 'hedges_won': 0,
-                          'hedges_wasted': 0, 'degraded': 0,
-                          'partial_responses': 0,
-                          'breaker_skips': 0,
-                          'breaker_forced_dials': 0,
-                          'epoch_updates': 0,
-                          'epoch_mismatches': 0,
-                          'corrupt_failovers': 0}
+        self._counters = {name: 0 for name in COUNTER_NAMES}
         # the hedge-delay source: observed partial latencies (also
         # exported through the typed registry as router_partial_ms)
         self._latency = obs_metrics.Histogram()
@@ -375,7 +388,8 @@ class Router(object):
                     st = MemberState(
                         name, topology.endpoint(name),
                         Breaker(self.conf['failures'],
-                                self.conf['cooldown_ms']))
+                                self.conf['cooldown_ms'],
+                                name=name))
                     self.states[name] = st
                     if self._prober_started:
                         self._start_prober(name, st)
@@ -525,6 +539,17 @@ class Router(object):
         if not force and not st.breaker.allow():
             self._bump('breaker_skips')
             raise _BreakerOpen(name)
+        # trace propagation over the pooled v2 path: the partial
+        # carries the active trace id and asks for the member's span
+        # subtree, exactly like the v1 `--remote` client path — a
+        # traced routed query yields ONE joined tree spanning router
+        # and members (the member's subtree grafts under this
+        # router.partial span below)
+        tctx = obs_trace.current_trace()
+        if tctx is not None and 'trace' not in partial_req:
+            partial_req = dict(partial_req,
+                               trace={'id': tctx.trace_id,
+                                      'want': True})
         try:
             with obs_trace.span('router.partial', member=name,
                                 partition=pid):
@@ -534,6 +559,8 @@ class Router(object):
                 rc, header, out, err = mod_client.request_bytes(
                     st.endpoint, partial_req, timeout_s=timeout_s,
                     pooled=True)
+                if tctx is not None:
+                    mod_client.graft_remote_trace(tctx, header)
         except (OSError, ValueError, DNError) as e:
             st.breaker.record_failure()
             raise DNError('member "%s"' % name,
@@ -644,6 +671,10 @@ class Router(object):
                         obs_trace.event('router.hedge',
                                         partition=pid,
                                         member=ranked[nxt])
+                        if obs_events.enabled():
+                            obs_events.emit('router.hedge',
+                                            partition=pid,
+                                            to=ranked[nxt])
                         launch(ranked[nxt], 'hedge')
                         nxt += 1
                         outstanding += 1
@@ -672,6 +703,12 @@ class Router(object):
                     self._bump('failovers')
                     obs_trace.event('router.failover', partition=pid,
                                     to=ranked[nxt])
+                    if obs_events.enabled():
+                        obs_events.emit(
+                            'router.failover', partition=pid,
+                            to=ranked[nxt], frm=name,
+                            error=getattr(value, 'message', None)
+                            if value is not None else 'breaker open')
                     launch(ranked[nxt], 'failover')
                     nxt += 1
                     outstanding += 1
@@ -688,6 +725,10 @@ class Router(object):
                         obs_trace.event('router.breaker_force',
                                         partition=pid,
                                         member=skip_name)
+                        if obs_events.enabled():
+                            obs_events.emit('router.breaker_force',
+                                            partition=pid,
+                                            to=skip_name)
                         launch(skip_name, 'forced', force=True)
                         outstanding += 1
             detail = '; '.join(
@@ -807,6 +848,10 @@ class Router(object):
             self._bump('degraded')
             detail = '; '.join(
                 failures[p].message for p in missing[:2])
+            if obs_events.enabled():
+                obs_events.emit('router.degraded',
+                                partitions=list(missing),
+                                error=detail)
             hints = [getattr(failures[p], 'retry_after_ms', None)
                      for p in missing]
             hints = [h for h in hints if h is not None]

@@ -41,6 +41,7 @@ import threading
 
 from ..errors import DNError
 from .. import integrity as mod_integrity
+from ..obs import events as obs_events
 from ..obs import metrics as obs_metrics
 from . import rebalance as mod_rebalance
 
@@ -99,6 +100,9 @@ class RepairManager(object):
                 self._queue.append((dsname, key[0], rel))
                 self.counters['scheduled'] += 1
                 started = True
+                if obs_events.enabled():
+                    obs_events.emit('repair.scheduled', shard=rel,
+                                    ds=dsname)
         if started:
             self._wake.set()
             self._ensure_thread()
@@ -148,6 +152,9 @@ class RepairManager(object):
                 self._bump('completed')
             else:
                 self._bump('failed')
+            obs_events.emit(
+                'repair.completed' if ok else 'repair.failed',
+                shard=rel, ds=dsname)
 
     def _repair_one(self, dsname, indexroot, rel):
         """Pull one shard's good copy from a committed co-replica,
@@ -365,6 +372,17 @@ class ScrubThread(object):
                     self.last = doc
                     self.last_error = None
                 obs_metrics.inc('integrity_scrub_runs_total')
+                if obs_events.enabled():
+                    trees = doc.get('trees') or {}
+                    obs_events.emit(
+                        'scrub.summary',
+                        trees=len(trees),
+                        corrupt=sum(
+                            len(t.get('corrupt_shards') or [])
+                            for t in trees.values()),
+                        missing=sum(
+                            len(t.get('missing_shards') or [])
+                            for t in trees.values()))
             except Exception as e:
                 with self._lock:
                     self.last_error = repr(e)

@@ -67,7 +67,9 @@ from .. import vpipe as mod_vpipe
 from .. import index_query_mt as mod_iqmt
 from .. import log as mod_log
 from ..errors import DNError
+from ..obs import events as obs_events
 from ..obs import export as obs_export
+from ..obs import history as obs_history
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..watchdog import LeakCheck
@@ -307,6 +309,11 @@ class DnServer(object):
             tenant_weights=conf['tenant_weights'],
             tenant_default_weight=conf['tenant_default_weight'])
         self.coalescer = mod_admission.Coalescer(conf['coalesce'])
+        # fleet observability (obs/history.py, obs/events.py,
+        # serve/fleet.py): the metric-history snapshotter and the
+        # event journal are armed at bind from DN_METRICS_HISTORY_S /
+        # DN_EVENTS — both off by default, costing nothing disabled
+        self.history = None
         self.log = mod_log.get('serve')
         self.running = False
         self.draining = False
@@ -394,6 +401,17 @@ class DnServer(object):
                 self, self.integrity_conf['scrub_interval_s'],
                 self.integrity_conf['scrub_rate_mb_s'] << 20,
                 log=self.log).start()
+        # the event journal is per-PROCESS (emit sites are global,
+        # like DN_TRACE): the first server to bind installs it;
+        # embedded co-process members share it (the fleet merge
+        # dedupes their identical tails)
+        if obs_events.journal() is None:
+            obs_events.install(member=self.member)
+        hist_s = obs_history.history_interval_s()
+        if hist_s > 0:
+            self.history = obs_history.HistorySnapshotter(
+                hist_s, provider=self._history_provider,
+                log=self.log).start()
         self.log.info('listening',
                       socket=self.socket_path, port=self.bound_port,
                       member=self.member,
@@ -452,6 +470,8 @@ class DnServer(object):
         self.loop.shutdown(max(1.0, deadline - time.monotonic() + 1))
         if self.topo_watcher is not None:
             self.topo_watcher.stop()
+        if self.history is not None:
+            self.history.stop()
         if self.scrubber is not None:
             self.scrubber.stop()
         self.repair.stop()
@@ -493,6 +513,8 @@ class DnServer(object):
                 self._topo_counters['transitions'] += 1
                 obs_metrics.inc('topo_epoch_transitions_total')
                 obs_metrics.set_gauge('topo_epoch', committed.epoch)
+                obs_events.emit('topo.commit', epoch=committed.epoch,
+                                leaving=self.topo_leaving or None)
                 self.log.info('topology committed',
                               epoch=committed.epoch,
                               leaving=self.topo_leaving)
@@ -510,6 +532,8 @@ class DnServer(object):
                     self.pending = pending
                     obs_metrics.set_gauge('topo_pending_epoch',
                                           pending.epoch)
+                    obs_events.emit('topo.pending',
+                                    epoch=pending.epoch)
                     self._start_handoff(self.cluster, pending)
                     self.log.info('topology pending',
                                   epoch=pending.epoch)
@@ -521,6 +545,10 @@ class DnServer(object):
                 resolved = self.pending
                 self.pending = None
                 obs_metrics.set_gauge('topo_pending_epoch', 0)
+                if pending is None and \
+                        resolved.epoch > self.cluster.epoch:
+                    obs_events.emit('topo.abort',
+                                    epoch=resolved.epoch)
                 if pending is None and self.puller is not None and \
                         self.puller.target_epoch == resolved.epoch \
                         and resolved.epoch > self.cluster.epoch:
@@ -702,6 +730,33 @@ class DnServer(object):
             self._counters['requests'] += 1
             self._by_op[op] = self._by_op.get(op, 0) + 1
 
+    def _history_provider(self):
+        """Named operational series for the history snapshotter:
+        request/shed/error totals (the admission counters predate the
+        typed registry), live inflight depth, repair completions, and
+        follow ingest lag — the qps / shed-rate / repair-rate /
+        ingest-lag trends by their headline names."""
+        with self._stats_lock:
+            requests = self._counters['requests']
+            errors = self._counters['errors']
+            shed = (self._counters['shed_overloaded'] +
+                    self._counters['busy_rejected'])
+        out = {
+            'serve.requests': (obs_history.COUNTER_KIND, requests),
+            'serve.errors': (obs_history.COUNTER_KIND, errors),
+            'serve.shed': (obs_history.COUNTER_KIND, shed),
+            'serve.inflight': (obs_history.GAUGE_KIND,
+                               self.admission.depth()['active']),
+            'repair.completed': (obs_history.COUNTER_KIND,
+                                 self.repair.stats()['completed']),
+        }
+        from ..follow import stats_doc as follow_stats
+        fs = follow_stats()
+        if fs is not None:
+            out['follow.ingest_lag_ms'] = (
+                obs_history.GAUGE_KIND, fs.get('ingest_lag_ms'))
+        return out
+
     def stats_doc(self):
         counters = mod_vpipe.global_counters()
         with self._stats_lock:
@@ -767,6 +822,19 @@ class DnServer(object):
             # dashboards can gate on shape; histograms carry
             # p50/p90/p99 and cumulative buckets
             'metrics': obs_export.stats_section(counters=counters),
+            # metric-history rings (obs/history.py): windowed
+            # qps/shed/repair/lag trends when DN_METRICS_HISTORY_S
+            # arms the snapshotter; shape-stable disabled stub
+            # otherwise (versioned, like `metrics`)
+            'history': self.history.history.doc()
+            if self.history is not None
+            else obs_history.disabled_doc(),
+            # event-journal summary (obs/events.py): capacity/seq/
+            # drop counters only — the entries ride the `events` op,
+            # never /stats
+            'events': obs_events.journal().doc()
+            if obs_events.journal() is not None
+            else obs_events.disabled_doc(),
         }
         if self.router is not None:
             # scatter-gather observability: per-member breaker
@@ -987,6 +1055,46 @@ class DnServer(object):
             # it).  Like stats/health: never queued behind admission.
             body = obs_export.prometheus_text(
                 counters=mod_vpipe.global_counters())
+            return 0, body.encode(), b'', {}
+        if op == 'events':
+            # the event-journal tail (`dn events [--follow]` and the
+            # fleet scatter): entries with seq > `since`, newest
+            # `limit`.  Control plane: never queued behind admission.
+            j = obs_events.journal()
+            since = req.get('since') or 0
+            limit = req.get('limit')
+            if not isinstance(since, int) or isinstance(since, bool) \
+                    or (limit is not None and
+                        (not isinstance(limit, int) or
+                         isinstance(limit, bool) or limit < 1)):
+                self._bump('errors')
+                return (1, b'', b'dn: bad "since"/"limit" in events '
+                        b'request\n', {})
+            doc = {'member': self.member,
+                   'enabled': j is not None,
+                   'seq': j.seq if j is not None else 0,
+                   'events': j.tail(since=since, limit=limit)
+                   if j is not None else []}
+            body = json.dumps(doc, sort_keys=True) + '\n'
+            return 0, body.encode(), b'', {}
+        if op == 'fleet_stats':
+            # the cluster-aggregated view (serve/fleet.py): scatter
+            # stats/events to every topology member over the pooled
+            # path, merge one fleet doc.  Bounded by fleet_timeout_s
+            # per member — a dead member becomes an error slot,
+            # never a hang.  Control plane: no admission slot (the
+            # fleet view must render DURING the flood it describes).
+            from . import fleet as mod_fleet
+            limit = req.get('events')
+            if limit is not None and \
+                    (not isinstance(limit, int) or
+                     isinstance(limit, bool) or limit < 0):
+                self._bump('errors')
+                return (1, b'', b'dn: bad "events" in fleet_stats '
+                        b'request\n', {})
+            doc = mod_fleet.fleet_doc(
+                self, events_limit=50 if limit is None else limit)
+            body = json.dumps(doc, sort_keys=True, indent=2) + '\n'
             return 0, body.encode(), b'', {}
         if op == 'scrub':
             # one on-demand integrity pass (`dn scrub --remote`):
@@ -1476,6 +1584,10 @@ class DnServer(object):
             with self._topo_lock:
                 self._topo_counters['resyncs'] += 1
             obs_metrics.inc('topo_resyncs_total')
+            if obs_events.enabled():
+                obs_events.emit('topo.resync',
+                                epoch=self.cluster.epoch
+                                if self.cluster is not None else None)
             if self.topo_watcher is not None:
                 self.topo_watcher.poll_now()
             (result, missing), shared = self.coalescer.run(

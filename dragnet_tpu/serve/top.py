@@ -1,0 +1,202 @@
+"""`dn top`: a live terminal operator console over the fleet view.
+
+Plain ANSI redraw — no curses, no new dependencies: each frame homes
+the cursor (ESC[H), draws the fleet header (epoch, members
+up/draining/unreachable, qps, p50/p95, shed rate), the per-member
+table, and the scrolling event tail, clearing to end-of-screen
+(ESC[J) so shrinking frames leave no stale rows.  Polls the
+``fleet_stats`` op at DN_TOP_INTERVAL_MS; a server that is not a
+cluster member answers with a one-member fleet of itself, so the
+console degrades to single-process mode against a bare `--remote`
+socket with no mode switch.
+
+A fetch failure paints an error banner and keeps polling (the server
+coming back mid-incident is exactly when the operator is watching);
+Ctrl-C exits cleanly.  `--once` renders a single frame with no ANSI
+control codes — the scriptable/testable path.
+"""
+
+import json
+import sys
+import time
+
+from ..errors import DNError
+
+HOME = '\x1b[H'
+CLEAR_TO_END = '\x1b[J'
+BOLD, DIM, RESET = '\x1b[1m', '\x1b[2m', '\x1b[0m'
+
+EVENT_TAIL_ROWS = 12
+
+
+def _fmt(v, unit='', none='-'):
+    if v is None:
+        return none
+    if isinstance(v, float):
+        return ('%.1f%s' if v >= 10 else '%.2f%s') % (v, unit)
+    return '%s%s' % (v, unit)
+
+
+def _member_state(row):
+    if not row.get('ok'):
+        return 'DOWN'
+    if row.get('leaving'):
+        return 'leaving'
+    if row.get('draining'):
+        return 'draining'
+    if row.get('pending_epoch'):
+        return 'handoff'
+    return 'up'
+
+
+def render_frame(doc, ansi=True):
+    """The full frame for one fleet document; returns the string
+    (render and transport separated so tests pin the layout without a
+    terminal)."""
+    b, d, r = (BOLD, DIM, RESET) if ansi else ('', '', '')
+    lines = []
+    agg = doc.get('aggregate') or {}
+    lat = agg.get('latency') or {}
+    when = time.strftime('%H:%M:%S',
+                         time.localtime(doc.get('ts') or time.time()))
+    epoch = doc.get('epoch')
+    head = ('%sdn top%s  %s  epoch %s  members %d/%d up'
+            % (b, r, when, epoch if epoch is not None else '-',
+               doc.get('members_up', 0), doc.get('members_total', 0)))
+    if doc.get('members_draining'):
+        head += '  (%d draining)' % doc['members_draining']
+    if doc.get('unreachable'):
+        head += '  %sUNREACHABLE: %s%s' \
+            % (b, ','.join(doc['unreachable']), r)
+    if doc.get('epoch_skew'):
+        head += '  %sepoch skew %d%s' % (b, doc['epoch_skew'], r)
+    lines.append(head)
+    lines.append(
+        'qps %s  p50 %s  p95 %s  p99 %s  shed/s %s  requests %s  '
+        'errors %s'
+        % (_fmt(agg.get('qps_1m')), _fmt(lat.get('p50'), 'ms'),
+           _fmt(lat.get('p95'), 'ms'), _fmt(lat.get('p99'), 'ms'),
+           _fmt(agg.get('shed_rate_1m')), _fmt(agg.get('requests')),
+           _fmt(agg.get('errors'))))
+    rp = doc.get('repair') or {}
+    if rp.get('queued') or rp.get('completed') or rp.get('failed'):
+        lines.append('repair queued %d completed %d failed %d'
+                     % (rp.get('queued', 0), rp.get('completed', 0),
+                        rp.get('failed', 0)))
+    lines.append('')
+
+    cols = ('member', 'state', 'epoch', 'qps', 'p50', 'p95',
+            'inflight', 'shed', 'repair', 'lag')
+    widths = [11, 9, 7, 8, 9, 9, 10, 7, 7, 9]
+    lines.append(d + ''.join(c.ljust(w)
+                             for c, w in zip(cols, widths)) + r)
+    breakers = doc.get('breakers') or {}
+    for name in sorted((doc.get('members') or {})):
+        row = doc['members'][name]
+        state = _member_state(row)
+        br = breakers.get(name) or {}
+        if row.get('ok') and br.get('state') not in (None, 'closed'):
+            state += '!'          # this router's breaker is not closed
+        ep = row.get('epoch')
+        if row.get('pending_epoch'):
+            ep = '%s>%s' % (ep, row['pending_epoch'])
+        vals = (
+            name, state,
+            _fmt(ep), _fmt(row.get('qps_1m')),
+            _fmt(row.get('p50_ms'), 'ms'),
+            _fmt(row.get('p95_ms'), 'ms'),
+            '%s/%s' % (row.get('inflight', '-'),
+                       row.get('queued', '-'))
+            if row.get('ok') else '-',
+            _fmt(row.get('shed')), _fmt(row.get('repair_queued')),
+            _fmt(row.get('ingest_lag_ms'), 'ms'))
+        line = ''.join(str(v).ljust(w)
+                       for v, w in zip(vals, widths))
+        lines.append(line)
+    lines.append('')
+
+    events = doc.get('events') or []
+    if events:
+        lines.append(d + 'events' + r)
+        for e in events[-EVENT_TAIL_ROWS:]:
+            ets = time.strftime(
+                '%H:%M:%S', time.localtime(e.get('ts') or 0))
+            attrs = {k: v for k, v in e.items()
+                     if k not in ('ts', 'seq', 'type', 'member',
+                                  'trace')}
+            detail = ' '.join('%s=%s' % (k, v)
+                              for k, v in sorted(attrs.items()))
+            lines.append(('%s %-10s %-22s %s'
+                          % (ets, e.get('member') or '-',
+                             e.get('type') or '?', detail))[:118])
+    elif doc.get('members') and not any(
+            m.get('events') for m in doc['members'].values()
+            if m.get('ok')):
+        lines.append(d + 'events: journal disabled on every member '
+                     '(set DN_EVENTS / DN_EVENTS_FILE)' + r)
+    return '\n'.join(lines) + '\n'
+
+
+def fetch_fleet(remote, timeout_s=30.0, events_limit=None):
+    """One fleet_stats fetch; raises DNError on failure."""
+    from . import client as mod_client
+    req = {'op': 'fleet_stats'}
+    if events_limit is not None:
+        req['events'] = events_limit
+    rc, header, out, err = mod_client.request_bytes(
+        remote, req, timeout_s=timeout_s)
+    if rc != 0:
+        raise DNError(err.decode('utf-8', 'replace').strip()
+                      or 'fleet_stats failed')
+    try:
+        return json.loads(out.decode('utf-8'))
+    except ValueError as e:
+        raise DNError('malformed fleet_stats response',
+                      cause=DNError(str(e)))
+
+
+def top_main(remote, interval_ms, once=False, out=None):
+    """The console loop; returns the exit code.  `once` renders one
+    frame without ANSI control codes and exits."""
+    if out is None:
+        out = sys.stdout
+    first = True
+    while True:
+        banner = None
+        try:
+            doc = fetch_fleet(remote,
+                              timeout_s=max(30.0,
+                                            interval_ms / 1000.0))
+        except (DNError, OSError, ValueError) as e:
+            if once:
+                sys.stderr.write('dn: fleet fetch failed: %s\n'
+                                 % getattr(e, 'message', e))
+                return 1
+            doc = None
+            banner = ('fleet fetch failed: %s (retrying)'
+                      % getattr(e, 'message', e))
+        if once:
+            out.write(render_frame(doc, ansi=False))
+            out.flush()
+            return 0
+        frame = HOME
+        if doc is not None:
+            frame += render_frame(doc, ansi=True)
+        else:
+            frame += '%sdn top%s  %s\n' % (BOLD, RESET, banner)
+        frame += CLEAR_TO_END
+        if first:
+            # one full clear on entry so prior shell output does not
+            # bleed through between frames
+            frame = '\x1b[2J' + frame
+            first = False
+        try:
+            out.write(frame)
+            out.flush()
+        except (BrokenPipeError, OSError):
+            return 0
+        try:
+            time.sleep(interval_ms / 1000.0)
+        except KeyboardInterrupt:
+            out.write('\n')
+            return 0

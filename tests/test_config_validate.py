@@ -145,7 +145,7 @@ def test_serve_config_defaults():
                     'read_deadline_ms': 10000,
                     'write_deadline_ms': 60000, 'idle_ms': 300000,
                     'tenant_quota': 0, 'tenant_default_weight': 1,
-                    'tenant_weights': {}}
+                    'fleet_timeout_s': 5, 'tenant_weights': {}}
 
 
 def test_serve_config_parses_overrides():
@@ -162,6 +162,7 @@ def test_serve_config_parses_overrides():
                     'drain_s': 5, 'read_deadline_ms': 250,
                     'write_deadline_ms': 0, 'idle_ms': 900,
                     'tenant_quota': 3, 'tenant_default_weight': 2,
+                    'fleet_timeout_s': 5,
                     'tenant_weights': {'alice': 3, 'bob': 1}}
 
 
@@ -219,6 +220,12 @@ def test_obs_config_defaults():
     assert conf['trace'] is None
     assert conf['slow_ms'] is None
     assert len(conf['buckets']) == 14
+    # fleet observability (history rings, event journal, dn top):
+    # everything off by default
+    assert conf['history_s'] == 0
+    assert conf['events'] == 0
+    assert conf['events_file'] is None
+    assert conf['top_interval_ms'] == 1000
 
 
 def test_obs_config_parses_overrides(tmp_path):
@@ -226,10 +233,20 @@ def test_obs_config_parses_overrides(tmp_path):
         'DN_TRACE': 'stderr', 'DN_SLOW_MS': '250',
         'DN_METRICS_BUCKETS': '1,5,25'})
     assert conf == {'trace': 'stderr', 'slow_ms': 250,
-                    'buckets': [1.0, 5.0, 25.0]}
+                    'buckets': [1.0, 5.0, 25.0],
+                    'history_s': 0, 'events': 0,
+                    'events_file': None, 'top_interval_ms': 1000}
     path = str(tmp_path / 'trace.jsonl')
     conf = mod_config.obs_config(env={'DN_TRACE': path})
     assert conf['trace'] == path
+    evfile = str(tmp_path / 'events.jsonl')
+    conf = mod_config.obs_config(env={
+        'DN_METRICS_HISTORY_S': '5', 'DN_EVENTS': '2048',
+        'DN_EVENTS_FILE': evfile, 'DN_TOP_INTERVAL_MS': '250'})
+    assert conf['history_s'] == 5
+    assert conf['events'] == 2048
+    assert conf['events_file'] == evfile
+    assert conf['top_interval_ms'] == 250
 
 
 def test_obs_config_rejects_bad_values():
@@ -248,6 +265,32 @@ def test_obs_config_rejects_bad_values():
         err = mod_config.obs_config(env={'DN_METRICS_BUCKETS': bad})
         assert isinstance(err, DNError), bad
         assert str(err).startswith('DN_METRICS_BUCKETS: expected')
+
+
+def test_fleet_obs_config_rejects_bad_values():
+    for env, needle in (
+            ({'DN_METRICS_HISTORY_S': 'x'}, 'DN_METRICS_HISTORY_S'),
+            ({'DN_METRICS_HISTORY_S': '-1'}, 'DN_METRICS_HISTORY_S'),
+            ({'DN_EVENTS': 'many'}, 'DN_EVENTS'),
+            ({'DN_EVENTS': '-4'}, 'DN_EVENTS'),
+            ({'DN_TOP_INTERVAL_MS': '99'}, 'DN_TOP_INTERVAL_MS'),
+            ({'DN_TOP_INTERVAL_MS': 'x'}, 'DN_TOP_INTERVAL_MS'),
+            ({'DN_EVENTS_FILE': '/no/such/dir/ev.jsonl'},
+             'DN_EVENTS_FILE')):
+        err = mod_config.obs_config(env=env)
+        assert isinstance(err, DNError), env
+        assert str(err).startswith(needle), env
+
+
+def test_serve_config_fleet_timeout():
+    assert mod_config.serve_config(env={})['fleet_timeout_s'] == 5
+    conf = mod_config.serve_config(
+        env={'DN_SERVE_FLEET_TIMEOUT_S': '2'})
+    assert conf['fleet_timeout_s'] == 2
+    err = mod_config.serve_config(
+        env={'DN_SERVE_FLEET_TIMEOUT_S': '0'})
+    assert isinstance(err, DNError)
+    assert 'DN_SERVE_FLEET_TIMEOUT_S' in str(err)
 
 
 def test_backend_load_returns_fresh_config_on_error(tmp_path):

@@ -326,6 +326,7 @@ _STATS_SHAPE = {
     'draining': bool, 'requests': dict, 'inflight': dict,
     'caches': dict, 'counters': dict, 'device': dict,
     'faults': dict, 'recovery': dict, 'metrics': dict,
+    'history': dict, 'events': dict,
 }
 
 
@@ -351,6 +352,18 @@ def test_stats_schema_golden_shape(server, corpus):
     m = st['metrics']
     assert m['version'] == obs_export.STATS_METRICS_VERSION
     assert set(m) == {'version', 'counters', 'gauges', 'histograms'}
+    # fleet-observability sections (versioned like `metrics`):
+    # disabled-by-default stubs keep the shape stable for dashboards
+    from dragnet_tpu.obs import history as obs_history
+    from dragnet_tpu.obs import events as obs_events_mod
+    h = st['history']
+    assert h['version'] == obs_history.HISTORY_VERSION
+    assert set(h) == {'version', 'enabled', 'interval_s', 'capacity',
+                      'samples', 'nseries', 'series'}
+    ev = st['events']
+    assert ev['version'] == obs_events_mod.EVENTS_VERSION
+    assert set(ev) == {'version', 'enabled', 'capacity', 'seq',
+                       'buffered', 'dropped', 'file', 'spill_errors'}
     lat = m['histograms'].get('serve_op_latency_ms{op=query}')
     assert lat is not None
     assert lat['count'] >= 1

@@ -820,6 +820,9 @@ def test_serve_validate_ok(monkeypatch):
                    b'connect_timeout_s=5 deadline_ms=0\n'
                    b'obs config ok: trace=off slow_ms=off '
                    b'buckets=14\n'
+                   b'fleet obs ok: history_s=0 events=0 '
+                   b'events_file=off top_interval_ms=1000 '
+                   b'fleet_timeout_s=5\n'
                    b'router config ok: probe_ms=500 failures=3 '
                    b'cooldown_ms=2000 hedge_ms=0 fetch_timeout_s=60 '
                    b'partial=error\n'

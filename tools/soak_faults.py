@@ -664,6 +664,79 @@ class ClusterSoak(Soak):
                          'member breaker states')
         self.cluster_counters = counters
 
+    def fleet_obs_drill(self):
+        """Fleet observability mid-drill (member b is DEAD here):
+        `dn stats --cluster` through a surviving member must return a
+        COMPLETE fleet document — live members merged, the SIGKILLed
+        member marked unreachable, never a hang or a partial doc
+        presented as complete — and the event journal must have
+        captured the drill's failover and SIGKILL-recovery
+        (breaker-open) events with trace ids."""
+        self.ops += 1
+        t0 = time.time()
+        rc, out, err = run_cli(['stats', '--cluster', '--remote',
+                                self.socks['a']])
+        elapsed = time.time() - t0
+        if rc != 0:
+            self.violate('fleet drill: dn stats --cluster failed: %r'
+                         % err[-300:])
+            return
+        if elapsed > 60:
+            self.violate('fleet drill: fleet view took %.1fs with a '
+                         'dead member' % elapsed)
+        try:
+            doc = json.loads(out.decode('utf-8'))
+        except ValueError:
+            self.violate('fleet drill: malformed fleet doc')
+            return
+        if 'b' not in doc.get('unreachable', []):
+            self.violate('fleet drill: SIGKILLed member b not '
+                         'reported unreachable: %r'
+                         % doc.get('unreachable'))
+        if doc.get('complete'):
+            self.violate('fleet drill: fleet doc claims complete '
+                         'with a dead member')
+        for m in 'ac':
+            row = (doc.get('members') or {}).get(m) or {}
+            if not row.get('ok'):
+                self.violate('fleet drill: live member %s not '
+                             'merged: %r' % (m, row))
+        if not (doc.get('aggregate') or {}).get('latency'):
+            self.violate('fleet drill: no aggregate latency '
+                         'quantiles in the fleet doc')
+        if set(doc.get('epochs') or {}) < {'a', 'c'}:
+            self.violate('fleet drill: epoch table missing live '
+                         'members: %r' % doc.get('epochs'))
+        # the event journal captured the drill (the in-process
+        # members share the process journal; DN_SLOW_MS armed trace
+        # contexts, so request-path events carry trace ids)
+        rc, header, out, err = mod_client.request_bytes(
+            self.socks['a'], {'op': 'events'}, timeout_s=30.0)
+        if rc != 0:
+            self.violate('fleet drill: events op failed: %r'
+                         % err[-300:])
+            return
+        doc = json.loads(out.decode('utf-8'))
+        if not doc.get('enabled'):
+            self.violate('fleet drill: event journal not enabled')
+            return
+        events = doc.get('events') or []
+        failovers = [e for e in events
+                     if e.get('type') == 'router.failover']
+        if not failovers:
+            self.violate('fleet drill: no router.failover events in '
+                         'the journal after the kill drill')
+        elif not any(e.get('trace') for e in failovers):
+            self.violate('fleet drill: failover events captured '
+                         'without trace ids')
+        if not any(e.get('type') == 'breaker.open' and
+                   e.get('member') == 'b' for e in events):
+            self.violate('fleet drill: no breaker.open event for the '
+                         'SIGKILLed member')
+        self.note('fleet drill: %d journal events, %d failovers '
+                  'with trace ids'
+                  % (len(events), len(failovers)))
+
     def no_replica_drill(self):
         """Member b is dead; stop c too — partition 1 (replicas b,c)
         has no survivor.  The response must be the clean degraded
@@ -728,7 +801,14 @@ def soak_cluster(root, fast=False, verbose=True, floor=None):
     os.environ.update({
         'DN_ROUTER_PROBE_MS': '200', 'DN_ROUTER_FAILURES': '2',
         'DN_ROUTER_COOLDOWN_MS': '500', 'DN_ROUTER_HEDGE_MS': '40',
-        'DN_ROUTER_FETCH_TIMEOUT_S': '30'})
+        'DN_ROUTER_FETCH_TIMEOUT_S': '30',
+        # fleet observability under the drill: the event journal
+        # (in-process members + the SIGKILL-able subprocess inherit
+        # it) plus armed-but-silent tracing so journal entries carry
+        # trace ids (DN_SLOW_MS high enough that the slow log itself
+        # never fires)
+        'DN_EVENTS': '4096', 'DN_SLOW_MS': '86400000',
+        'DN_SERVE_FLEET_TIMEOUT_S': '5'})
     s = ClusterSoak(ctx, verbose=verbose)
     s.start_cluster()
     try:
@@ -756,6 +836,8 @@ def soak_cluster(root, fast=False, verbose=True, floor=None):
         s.note('SIGKILL partition-owner drill')
         s.kill_owner_drill(nthreads=2 if fast else 3,
                            per_thread=2 if fast else 4)
+        s.note('fleet observability drill (member b dead)')
+        s.fleet_obs_drill()
         s.note('no-surviving-replica drill')
         s.no_replica_drill()
     finally:
