@@ -7,7 +7,7 @@ PYTHON ?= python3
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
     bench-serve bench-cluster bench-follow bench-fanin bench-verify \
     soak-faults soak-cluster soak-follow soak-overload \
-    soak-rebalance soak-scrub clean parity-matrix
+    soak-rebalance soak-scrub soak-resources clean parity-matrix
 
 all: native
 
@@ -108,6 +108,16 @@ soak-rebalance: native
 # shard repaired from a co-replica, byte-identical to its catalog
 soak-scrub: native
 	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --scrub
+
+# resource-exhaustion survival: a 3-member routed cluster under query
+# flood while the simulated disk (DN_DISK_SIM_FILE) is forced through
+# a full low -> critical -> recovered cycle, with enospc/emfile
+# faults armed at every write seam — asserts queries byte-identical
+# throughout (including the read-only window), builds rejected with
+# the clean retryable disk-full error while critical, automatic write
+# resumption on recovery, zero torn shards, zero stranded tmps
+soak-resources: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --resources
 
 # verified-read overhead: warm + cold-open index-query p50/p95 under
 # DN_VERIFY=open vs off (bench extras JSON)

@@ -147,9 +147,11 @@ DN_OPTIONS = [
     # the walk to one index root, --repair pulls good copies from
     # cluster co-replicas, --check reports without quarantining,
     # --forget-missing drops catalog entries for shards gone from
-    # disk, --older-than age-gates `dn quarantine clean`.  Not in
+    # disk, --older-than age-gates `dn quarantine clean`,
+    # --max-bytes evicts oldest-first down to a byte budget.  Not in
     # USAGE_TEXT (byte-pinned); documented in docs/robustness.md.
     (['tree'], 'string', None),
+    (['max-bytes'], 'string', None),
     (['repair'], 'bool', None),
     (['check'], 'bool', None),
     (['forget-missing'], 'bool', None),
@@ -820,6 +822,20 @@ def cmd_build(ctx, argv):
 
         warn_func = _warn_printer if getattr(opts, 'warnings', None) \
             else None
+        # the local write gate (resources.py): a disk-critical index
+        # tree rejects the build up front with the clean retryable
+        # disk_full error instead of failing mid-publish
+        if not opts.dry_run:
+            from . import resources as mod_resources
+            res_conf = mod_config.resources_config()
+            if isinstance(res_conf, DNError):
+                fatal(res_conf)
+            try:
+                mod_resources.check_tree_writable(
+                    getattr(ds, 'ds_indexpath', None), res_conf,
+                    what='build')
+            except DNError as e:
+                fatal(e)
         with _pool_flag_env('build-threads', opts.build_threads,
                             'DN_BUILD_THREADS'), \
                 _mode_flag_env('parse', opts.parse, 'DN_PARSE',
@@ -895,7 +911,17 @@ def cmd_index_read(ctx, argv):
                                 index_config=indexcfg)
     if len(metrics) == 0:
         fatal(DNError('no metrics defined for dataset "%s"' % dsname))
+    # the write gate (resources.py): index-read lands shards — on a
+    # disk-critical tree it rejects up front, retryably, instead of
+    # consuming the stream and failing mid-publish
+    from . import resources as mod_resources
+    res_conf = mod_config.resources_config()
+    if isinstance(res_conf, DNError):
+        fatal(res_conf)
     try:
+        mod_resources.check_tree_writable(
+            getattr(ds, 'ds_indexpath', None), res_conf,
+            what='index-read')
         ds.index_read(metrics, opts.interval, sys.stdin.buffer)
     except DNError as e:
         fatal(e)
@@ -1103,6 +1129,9 @@ def cmd_follow(ctx, argv):
     obs_conf = mod_config.obs_config()
     if isinstance(obs_conf, DNError):
         fatal(obs_conf)
+    res_conf = mod_config.resources_config()
+    if isinstance(res_conf, DNError):
+        fatal(res_conf)
 
     ds = datasource_for_name(ctx['config'], dsname)
     if isinstance(ds, DNError):
@@ -1145,6 +1174,11 @@ def cmd_follow(ctx, argv):
             % (obs_conf['trace'] or 'off',
                obs_conf['slow_ms'] if obs_conf['slow_ms'] is not None
                else 'off', len(obs_conf['buckets'])))
+        sys.stdout.write(
+            'resources config ok: disk_low_pct=%g '
+            'disk_critical_pct=%g poll_ms=%d\n'
+            % (res_conf['disk_low_pct'],
+               res_conf['disk_critical_pct'], res_conf['poll_ms']))
         sys.stdout.write(
             'follow plan: datasource=%s interval=%s index=%s '
             'sources=%s\n'
@@ -1332,15 +1366,18 @@ def _scrub_repair(topo, member, dsname, indexroot, rels):
 
 
 def cmd_quarantine(ctx, argv):
-    """`dn quarantine list|clean [--older-than AGE] [--tree T]`:
-    inspect and prune `.dn_quarantine/` — the forensics directory
-    every crash rollback and corrupt-detect moves artifacts into,
-    and nothing ever pruned before this command existed.  AGE:
-    '30s'/'15m'/'12h'/'7d' or bare seconds (clean defaults to
-    everything).  Not in USAGE_TEXT (byte-pinned); documented in
-    docs/robustness.md."""
+    """`dn quarantine list|clean [--older-than AGE] [--max-bytes N]
+    [--tree T]`: inspect and prune `.dn_quarantine/` — the forensics
+    directory every crash rollback and corrupt-detect moves
+    artifacts into, and nothing ever pruned before this command
+    existed.  AGE: '30s'/'15m'/'12h'/'7d' or bare seconds (clean
+    defaults to everything).  `--max-bytes N` evicts OLDEST-FIRST
+    only until each tree's quarantine fits the byte budget (newest
+    forensics survive); the serve scrub timer applies the same
+    eviction automatically under DN_QUARANTINE_MAX_MB.  Not in
+    USAGE_TEXT (byte-pinned); documented in docs/robustness.md."""
     from . import integrity as mod_integrity
-    opts = dn_parse_args(argv, ['tree', 'older-than'])
+    opts = dn_parse_args(argv, ['tree', 'older-than', 'max-bytes'])
     if len(opts._args) < 1:
         raise UsageError('missing quarantine subcommand')
     sub = opts._args[0]
@@ -1362,11 +1399,20 @@ def cmd_quarantine(ctx, argv):
         check_arg_count(opts, 1)
         age_s = _parse_age(opts.older_than) \
             if opts.older_than is not None else 0
+        max_bytes = None
+        if opts.max_bytes is not None:
+            try:
+                max_bytes = int(opts.max_bytes)
+                if max_bytes < 0:
+                    raise ValueError(opts.max_bytes)
+            except ValueError:
+                raise UsageError('bad value for "max-bytes": "%s"'
+                                 % opts.max_bytes)
         removed = 0
         freed = 0
         for dsname, root in _integrity_trees(opts):
             n, b = mod_integrity.quarantine_clean(
-                root, older_than_s=age_s)
+                root, older_than_s=age_s, max_bytes=max_bytes)
             removed += n
             freed += b
         sys.stderr.write('dn quarantine: removed %d file(s), '
@@ -1541,6 +1587,9 @@ def cmd_serve(ctx, argv):
     integ_conf = mod_config.integrity_config()
     if isinstance(integ_conf, DNError):
         fatal(integ_conf)
+    res_conf = mod_config.resources_config()
+    if isinstance(res_conf, DNError):
+        fatal(res_conf)
 
     cluster = opts.cluster or os.environ.get('DN_SERVE_TOPOLOGY') \
         or None
@@ -1629,9 +1678,18 @@ def cmd_serve(ctx, argv):
                topo_conf['handoff_retries'], topo_conf['max_moves']))
         sys.stdout.write(
             'integrity config ok: verify=%s scrub_interval_s=%d '
-            'scrub_rate_mb_s=%d\n'
+            'scrub_rate_mb_s=%d quarantine_max_mb=%d\n'
             % (integ_conf['verify'], integ_conf['scrub_interval_s'],
-               integ_conf['scrub_rate_mb_s']))
+               integ_conf['scrub_rate_mb_s'],
+               integ_conf['quarantine_max_mb']))
+        sys.stdout.write(
+            'resources config ok: disk_low_pct=%g '
+            'disk_critical_pct=%g poll_ms=%d mem_budget_mb=%d '
+            'fd_headroom=%d events_file_max_mb=%d\n'
+            % (res_conf['disk_low_pct'],
+               res_conf['disk_critical_pct'], res_conf['poll_ms'],
+               res_conf['mem_budget_mb'], res_conf['fd_headroom'],
+               obs_conf['events_file_max_mb']))
         if topo is not None:
             sys.stdout.write(
                 'cluster topology ok: member=%s epoch=%d assign=%s '

@@ -634,12 +634,19 @@ _SCRUB_KNOBS = [
     # must never compete with the serving path for disk.  0 =
     # unlimited.
     ('DN_SCRUB_RATE_MB_S', 'int', 64, 0),
+    # quarantine byte budget (MB): past it the serve scrub timer
+    # auto-evicts the OLDEST quarantined forensics until the
+    # directory fits — quarantined corruption must never fill the
+    # disk it was saved from.  0 (the default) keeps the manual-only
+    # `dn quarantine clean` contract.
+    ('DN_QUARANTINE_MAX_MB', 'int', 0, 0),
 ]
 
 
 def integrity_config(env=None):
     """The resolved integrity knobs (keys: verify, scrub_interval_s,
-    scrub_rate_mb_s), or DNError on the first malformed value.
+    scrub_rate_mb_s, quarantine_max_mb), or DNError on the first
+    malformed value.
 
     * DN_VERIFY: 'off' (default — byte-identical to the unverified
       path), 'open' (size+crc32 checked against the tree's integrity
@@ -675,6 +682,75 @@ def integrity_config(env=None):
     return rv
 
 
+# --- resource-governance knobs (DN_DISK_* / DN_SERVE_MEM_BUDGET_MB) ---
+#
+# Same contract as the serve/remote knobs: parsed and validated in one
+# place (resources.py consumes them; `dn serve --validate` and
+# `dn follow --validate` check them up front).
+
+_RESOURCE_KNOBS = [
+    # free-space watermarks (percent of the filesystem): below LOW the
+    # governor pauses background disk consumers; below CRITICAL the
+    # member flips read-only (queries keep serving byte-identically)
+    ('DN_DISK_LOW_PCT', 'float', 10.0, 0.0),
+    ('DN_DISK_CRITICAL_PCT', 'float', 5.0, 0.0),
+    # statvfs/fd poll cadence for the governor
+    ('DN_RESOURCE_POLL_MS', 'int', 2000, 50),
+    # admission-level memory budget: the concurrent estimated request
+    # footprint `dn serve` admits before shedding with retry_after_ms
+    # (0 = disabled)
+    ('DN_SERVE_MEM_BUDGET_MB', 'int', 0, 0),
+    # minimum spare fds before the governor reports low pressure
+    # (0 disables the fd check)
+    ('DN_FD_HEADROOM', 'int', 64, 0),
+]
+
+
+def resources_config(env=None):
+    """The resolved resource-governor knobs (keys: disk_low_pct,
+    disk_critical_pct, poll_ms, mem_budget_mb, fd_headroom), or
+    DNError on the first malformed value — the shared fail-fast
+    contract `dn serve --validate` checks.  The critical watermark
+    must not exceed the low one (the mode machine is ordered)."""
+    if env is None:
+        env = os.environ
+    keys = {'DN_DISK_LOW_PCT': 'disk_low_pct',
+            'DN_DISK_CRITICAL_PCT': 'disk_critical_pct',
+            'DN_RESOURCE_POLL_MS': 'poll_ms',
+            'DN_SERVE_MEM_BUDGET_MB': 'mem_budget_mb',
+            'DN_FD_HEADROOM': 'fd_headroom'}
+    rv = {}
+    for name, kind, default, minimum in _RESOURCE_KNOBS:
+        key = keys[name]
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        if kind == 'float':
+            try:
+                value = float(raw)
+            except ValueError:
+                value = None
+            if value is None or not minimum <= value <= 100.0:
+                return DNError('%s: expected a number in [%g, 100], '
+                               'got "%s"' % (name, minimum, raw))
+        else:
+            try:
+                value = int(raw)
+            except ValueError:
+                value = minimum - 1
+            if value < minimum:
+                return DNError('%s: expected an integer >= %d, '
+                               'got "%s"' % (name, minimum, raw))
+        rv[key] = value
+    if rv['disk_critical_pct'] > rv['disk_low_pct']:
+        return DNError('DN_DISK_CRITICAL_PCT (%g) must not exceed '
+                       'DN_DISK_LOW_PCT (%g)'
+                       % (rv['disk_critical_pct'],
+                          rv['disk_low_pct']))
+    return rv
+
+
 # --- observability knobs (DN_TRACE / DN_SLOW_MS / DN_METRICS_BUCKETS) -
 #
 # Same contract as the serve/remote knobs: parsed and validated in one
@@ -685,8 +761,8 @@ def integrity_config(env=None):
 
 def obs_config(env=None):
     """The resolved observability knobs (keys: trace, slow_ms,
-    buckets, history_s, events, events_file, top_interval_ms), or
-    DNError on the first malformed value.
+    buckets, history_s, events, events_file, events_file_max_mb,
+    top_interval_ms), or DNError on the first malformed value.
 
     * DN_TRACE: '' (off), 'stderr', or a trace-file path (one JSON
       span-tree line per request is appended).
@@ -701,6 +777,8 @@ def obs_config(env=None):
     * DN_EVENTS_FILE: optional JSONL spill path for the journal
       (implies a default ring when DN_EVENTS is unset); its directory
       must exist, like DN_TRACE's.
+    * DN_EVENTS_FILE_MAX_MB: spill size cap before rotation to
+      `<path>.1` (obs/events.py); 0 disables rotation.
     * DN_TOP_INTERVAL_MS: `dn top` poll cadence, integer >= 100.
     """
     if env is None:
@@ -728,6 +806,11 @@ def obs_config(env=None):
     for name, key, default, minimum in (
             ('DN_METRICS_HISTORY_S', 'history_s', 0, 0),
             ('DN_EVENTS', 'events', 0, 0),
+            # size cap (MB) for the DN_EVENTS_FILE JSONL spill: past
+            # it the file rotates to `<path>.1` (one predecessor
+            # kept); 0 disables rotation (the pre-cap unbounded
+            # growth, opt-in only)
+            ('DN_EVENTS_FILE_MAX_MB', 'events_file_max_mb', 64, 0),
             ('DN_TOP_INTERVAL_MS', 'top_interval_ms', 1000, 100)):
         raw = env.get(name)
         if raw is None or raw == '':

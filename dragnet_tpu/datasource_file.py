@@ -403,9 +403,17 @@ class DatasourceFile(object):
 
     def build(self, metrics, interval, time_after=None, time_before=None,
               dry_run=False, warn_func=None):
-        return self._index_scan_impl(
-            metrics, interval, self.ds_filter, time_after, time_before,
-            dry_run, sink='index', warn_func=warn_func)
+        from . import resources as mod_resources
+        # a full disk / exhausted fd table mid-build (real, or armed
+        # enospc/emfile at the sink/journal seams) surfaces as the
+        # clean retryable disk_full DNError, never a traceback — the
+        # two-phase journal already guarantees the tree is left
+        # pre-build or post-build, never torn
+        with mod_resources.translate_pressure_errors('index build'):
+            return self._index_scan_impl(
+                metrics, interval, self.ds_filter, time_after,
+                time_before, dry_run, sink='index',
+                warn_func=warn_func)
 
     def index_scan(self, metrics, interval, filter=None, time_after=None,
                    time_before=None, warn_func=None):
@@ -920,23 +928,25 @@ class DatasourceFile(object):
             raise error
         pipeline = Pipeline()
         from . import index_build_mt as mod_ibmt
+        from . import resources as mod_resources
         writer = mod_ibmt.StreamingIndexWriter(metrics, interval,
                                                self.ds_indexpath)
-        try:
-            chunk = []
-            for rec in mod_ingest.iter_records(
-                    mod_ingest.iter_stream_lines(instream),
-                    'json-skinner', pipeline):
-                chunk.append(rec)
-                if len(chunk) >= self.INDEX_READ_CHUNK:
+        with mod_resources.translate_pressure_errors('index-read'):
+            try:
+                chunk = []
+                for rec in mod_ingest.iter_records(
+                        mod_ingest.iter_stream_lines(instream),
+                        'json-skinner', pipeline):
+                    chunk.append(rec)
+                    if len(chunk) >= self.INDEX_READ_CHUNK:
+                        writer.write_points(chunk)
+                        chunk = []
+                if chunk:
                     writer.write_points(chunk)
-                    chunk = []
-            if chunk:
-                writer.write_points(chunk)
-            writer.finish()
-        except BaseException:
-            writer.abort()
-            raise
+                writer.finish()
+            except BaseException:
+                writer.abort()
+                raise
         return ScanResult(pipeline)
 
     # -- query ------------------------------------------------------------

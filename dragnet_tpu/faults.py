@@ -26,6 +26,14 @@ deterministic regardless.)  Kinds:
   cut.  Sites without a torn_path degrade to ``error``.
 * ``kill``  — SIGKILL the process at the seam (mid-flush crash
   drills; only meaningful under a subprocess harness).
+* ``enospc`` / ``emfile`` — resource exhaustion: raise
+  ``OSError(ENOSPC)`` / ``OSError(EMFILE)`` at the seam, exactly what
+  a full disk or an exhausted fd table produces mid-write.  Armed at
+  every write seam (sink create/flush/rename, journal commit record,
+  follow checkpoint, integrity catalog update, events spill, handoff
+  apply, repair land) to prove each leaves a recoverable tree —
+  journal rolls back, no torn shards, no stranded tmps
+  (docs/robustness.md, the resource-governance section).
 * ``flip``  — silent corruption: at sites that hand a file path
   (``flip_path``, or ``torn_path`` where no safer target exists),
   XOR one seeded-random byte of the target file and CONTINUE — the
@@ -55,7 +63,8 @@ import time
 from .errors import DNError
 from .vpipe import counter_bump
 
-KINDS = ('error', 'torn', 'delay', 'kill', 'flip')
+KINDS = ('error', 'torn', 'delay', 'kill', 'flip', 'enospc',
+         'emfile')
 
 # the injection-site catalog (docs/robustness.md documents each seam)
 SITES = (
@@ -83,6 +92,10 @@ SITES = (
     'handoff.manifest',  # handoff: donor shard-manifest build
     'handoff.fetch',    # handoff: joiner per-shard fetch
     'handoff.apply',    # handoff: joiner shard rename-into-place
+    'journal.commit',   # index journal: the commit-record write
+    'integrity.catalog',  # integrity: catalog read-modify-write
+    'events.spill',     # obs/events: the JSONL spill append
+    'repair.land',      # serve/scrub: replica-repair shard landing
 )
 
 
@@ -196,6 +209,11 @@ def fire(site, torn_path=None, flip_path=None):
     if kind == 'delay':
         time.sleep(_delay_s())
         return
+    if kind in ('enospc', 'emfile'):
+        import errno
+        code = errno.ENOSPC if kind == 'enospc' else errno.EMFILE
+        raise OSError(code, 'injected %s at "%s"'
+                      % (kind.upper(), site))
     if kind == 'kill':
         os.kill(os.getpid(), signal.SIGKILL)
     if kind == 'torn' and torn_path is not None:

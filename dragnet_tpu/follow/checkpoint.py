@@ -94,8 +94,18 @@ class Checkpointer(object):
                         for p, dev, ino, off in sources],
         }
         tmp = journal.tmp_for(self.path)
-        with open(tmp, 'w') as f:
-            f.write(json.dumps(doc))
-            f.flush()
-            os.fsync(f.fileno())
+        try:
+            with open(tmp, 'w') as f:
+                f.write(json.dumps(doc))
+                f.flush()
+                os.fsync(f.fileno())
+        except BaseException:
+            # a half-written checkpoint tmp (ENOSPC mid-write) is
+            # pre-commit litter, not recoverable intent — the retry
+            # re-prepares from scratch; never strand it
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return self.path

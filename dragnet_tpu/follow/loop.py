@@ -26,10 +26,12 @@ import time
 from ..errors import DNError
 from .. import jsvalues as jsv
 from ..datasource_file import DatasourceFile
+from ..obs import events as obs_events
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..vpipe import counter_bump
 from .. import index_journal as mod_journal
+from .. import resources as mod_resources
 from .batcher import MiniBatcher
 from .checkpoint import Checkpointer
 from .publisher import merge_publish
@@ -60,6 +62,18 @@ class FollowLoop(object):
     # consecutive all-error zero-byte poll passes tolerated in --once
     # before draining with exit code 1 instead of claiming caught-up
     ONCE_POLL_RETRIES = 5
+    # disk-pressure pauses tolerated while DRAINING before giving up
+    # (a transient full disk must not turn a drain into rc=1, but an
+    # operator's SIGTERM must still win against a permanently full
+    # one) — deliberately larger than the failure-streak budget:
+    # pauses are EXPECTED under pressure, failures are not
+    DRAIN_PAUSE_RETRIES = 10
+    # publish-pause backoff ceiling (seconds)
+    PAUSE_BACKOFF_MAX_S = 5.0
+    # while paused, sources keep tailing only until the pending queue
+    # holds this many mini-batches' worth of bytes — the follower
+    # must not become its own memory exhaustion under a full disk
+    PAUSE_QUEUE_BATCHES = 4
 
     def __init__(self, ds, metrics, interval, sources, conf,
                  once=False, warn=None):
@@ -94,6 +108,20 @@ class FollowLoop(object):
         self.ckpt_wall = None
         self.lag_ms = 0.0
         self._stop = threading.Event()
+        # resource governance (resources.py): low/critical disk
+        # pressure PAUSES publishing — checkpoint held, sources keep
+        # tailing into the bounded queue, automatic resume when space
+        # frees — instead of burning the failure streak on a
+        # transient full disk
+        from .. import config as mod_config
+        res_conf = mod_config.resources_config()
+        if isinstance(res_conf, DNError):
+            # the CLI validates up front; an embedder's bad env must
+            # not crash the loop — fall back to defaults
+            res_conf = mod_config.resources_config(env={})
+        self.governor = mod_resources.ResourceGovernor(
+            res_conf, paths=[self.indexroot])
+        self.pauses = 0
 
     def request_stop(self):
         self._stop.set()
@@ -239,6 +267,7 @@ class FollowLoop(object):
             'pending_bytes': self.batcher.pending_bytes(),
             'checkpoint_age_s': age,
             'ingest_lag_ms': round(self.lag_ms, 3),
+            'publish_pauses': self.pauses,
             'sources': srcs,
         })
 
@@ -261,20 +290,52 @@ class FollowLoop(object):
                 self.batcher.add(buf)
         return sum(t.read_off for t in self.tailers) - pre, errs
 
+    def _note_pause(self, stopping, why):
+        """One disk-pressure pause tick: counted, surfaced, bounded
+        backoff (the checkpoint is HELD — nothing published, nothing
+        lost; the retry is exact)."""
+        self.pauses += 1
+        counter_bump('follow publishes paused')
+        obs_metrics.inc('follow_publish_pauses_total')
+        obs_events.emit_burst('resource.paused', key='follow',
+                             component='follow', why=why)
+        if self.pauses == 1 or stopping:
+            self.warn('publish paused: %s (checkpoint held; '
+                      'resuming when the resource frees)' % why)
+        delay = min(self.PAUSE_BACKOFF_MAX_S,
+                    (self.conf['poll_ms'] / 1000.0) *
+                    max(1, self.pauses))
+        if self._stop.is_set():
+            # draining: _stop is already set, so waiting on it would
+            # return instantly and burn every DRAIN_PAUSE_RETRIES in
+            # milliseconds — the pause must really pace the drain
+            time.sleep(delay)
+        else:
+            self._stop.wait(delay)
+
     def run(self):
         with obs_trace.span('follow.resume'):
             self.resume()
         self._refresh_stats()
         poll_s = self.conf['poll_ms'] / 1000.0
+        pause_cap = self.PAUSE_QUEUE_BATCHES * self.conf['max_bytes']
         pending = None
         fails = 0
+        drain_pauses = 0
+        attempt_recover = False
         poll_fails = 0
         once_rc = 0
         draining = False
         while True:
             stopping = self._stop.is_set() or draining
+            paused = self.governor.mode() != 'ok'
             got = errs = 0
-            if not stopping:
+            if not stopping and not (paused and
+                                     self.batcher.pending_bytes() >=
+                                     pause_cap):
+                # under pressure the sources keep tailing only until
+                # the pending queue holds PAUSE_QUEUE_BATCHES batches
+                # of bytes — bounded, like everything else here
                 got, errs = self._poll_all()
             if self.once and not stopping:
                 # --once promises "ingest to the sources' current
@@ -318,23 +379,62 @@ class FollowLoop(object):
                     (self.batcher.ready() or
                      (stopping and self.batcher.pending_bytes() > 0)):
                 pending = self.batcher.cut(self._offsets())
-            if pending is not None:
+            if pending is not None and paused and not stopping:
+                # pressure pause: hold the batch (and its checkpoint)
+                # without even attempting the publish — hammering a
+                # known-full disk buys nothing, and every attempt is
+                # an abort/retry cycle
+                self._note_pause(stopping,
+                                 'disk %s' % self.governor.mode())
+            elif pending is not None:
                 try:
                     # recovery only on a retry: a failed previous
                     # attempt is the one in-process way journal
                     # intent can be left on this single-writer tree
-                    self.publish_batch(pending, recover=fails > 0)
+                    self.publish_batch(pending,
+                                       recover=attempt_recover)
                     pending = None
                     fails = 0
-                except DNError as e:
-                    fails += 1
-                    self.warn('publish failed (attempt %d): %s'
-                              % (fails, getattr(e, 'message', e)))
-                    if stopping and \
-                            fails >= self.DRAIN_PUBLISH_RETRIES:
-                        self._refresh_stats()
-                        return 1
-                    time.sleep(min(2.0, poll_s * fails))
+                    drain_pauses = 0
+                    attempt_recover = False
+                    if self.pauses:
+                        self.pauses = 0
+                        self.warn('publish resumed')
+                except (DNError, OSError) as e:
+                    attempt_recover = True
+                    if mod_resources.is_pressure_error(e):
+                        # ENOSPC/EMFILE is PAUSABLE, not a failure:
+                        # the checkpoint is held, nothing landed (or
+                        # recoverable intent only — the retry
+                        # completes it), and the streak that would
+                        # end a drain with rc=1 is not burned on a
+                        # transient full disk
+                        self.governor.note_pressure_error(
+                            e if isinstance(e, OSError) else None)
+                        if stopping:
+                            drain_pauses += 1
+                            if drain_pauses >= \
+                                    self.DRAIN_PAUSE_RETRIES:
+                                self.warn(
+                                    'giving up on the drain: disk '
+                                    'pressure outlasted %d pause(s)'
+                                    % drain_pauses)
+                                self._refresh_stats()
+                                return 1
+                        self._note_pause(
+                            stopping, str(getattr(e, 'message',
+                                                  None) or e))
+                    elif isinstance(e, OSError):
+                        raise
+                    else:
+                        fails += 1
+                        self.warn('publish failed (attempt %d): %s'
+                                  % (fails, getattr(e, 'message', e)))
+                        if stopping and \
+                                fails >= self.DRAIN_PUBLISH_RETRIES:
+                            self._refresh_stats()
+                            return 1
+                        time.sleep(min(2.0, poll_s * fails))
             self._refresh_stats()
             if stopping and pending is None and \
                     self.batcher.pending_bytes() == 0:

@@ -318,8 +318,24 @@ def publish_prepared(journal, sinks, paths, extra_paths=None):
         integ = mod_integrity.integrity_entries(
             [os.path.abspath(p) for p in paths],
             tmp_for=journal.tmp_for)
-        journal.record_commit(list(paths) + extra_paths,
-                              integrity=integ)
+        try:
+            journal.record_commit(list(paths) + extra_paths,
+                                  integrity=integ)
+        except BaseException:
+            # PRE-commit failure (e.g. ENOSPC on the record itself):
+            # nothing was published, so the prepared tmps are not
+            # recoverable intent — discard them all.  A retry loop
+            # (follow's publish backoff) must never fill the disk
+            # with one stranded prepared set per failed attempt.
+            for sink in sinks:
+                if sink is not None:
+                    sink.abort()
+            for path in extra_paths:
+                try:
+                    os.unlink(journal.tmp_for(path))
+                except OSError:
+                    pass
+            raise
         err = None
         for sink, path in zip(sinks, paths):
             try:

@@ -191,11 +191,25 @@ class BuildJournal(object):
         # a zero-bucket build never had a sink create indexroot, but
         # the commit record still lands there
         os.makedirs(self.indexroot, exist_ok=True)
-        with open(tmp, 'w') as f:
-            f.write(json.dumps(doc))
-            f.flush()
-            os.fsync(f.fileno())
-        os.rename(tmp, self.path)
+        try:
+            # the resource-exhaustion seam: an ENOSPC here is
+            # PRE-commit — no record landed, the caller aborts its
+            # prepared tmps and the tree is exactly pre-build
+            from . import faults as mod_faults
+            mod_faults.fire('journal.commit')
+            with open(tmp, 'w') as f:
+                f.write(json.dumps(doc))
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, self.path)
+        except BaseException:
+            # never strand a half-written record tmp: the commit
+            # point was not reached, so the tmp is pure litter
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def retire(self):
         try:

@@ -209,11 +209,25 @@ def update_catalog(indexroot, add=None, remove=None):
                 shards[rel] = [int(size), int(crc)]
             out_doc = {'version': CATALOG_VERSION, 'shards': shards}
             tmp = path + '.%d.tmp' % os.getpid()
-            with open(tmp, 'w') as f:
-                f.write(json.dumps(out_doc, sort_keys=True))
-                f.flush()
-                os.fsync(f.fileno())
-            os.rename(tmp, path)
+            try:
+                # the resource-exhaustion seam: an ENOSPC here leaves
+                # the committed catalog untouched (tmp+rename) and no
+                # tmp litter; when the update rode a publish whose
+                # commit record carries the same entries, the
+                # sweep's roll-forward re-lands them after recovery
+                from . import faults as mod_faults
+                mod_faults.fire('integrity.catalog')
+                with open(tmp, 'w') as f:
+                    f.write(json.dumps(out_doc, sort_keys=True))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.rename(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
         finally:
             lockf.close()        # releases the flock
     _drop_catalog_memo(indexroot)
@@ -578,14 +592,22 @@ def quarantine_stats(indexroot):
             'bytes': sum(e[1] for e in entries)}
 
 
-def quarantine_clean(indexroot, older_than_s=0):
+def quarantine_clean(indexroot, older_than_s=0, max_bytes=None):
     """Delete quarantined artifacts older than `older_than_s` (0 =
-    everything).  Returns (files_removed, bytes_removed).  This is
-    the ONLY place quarantined forensics are deleted — and only on
-    operator request (`dn quarantine clean`)."""
+    everything).  With `max_bytes`, evict OLDEST-FIRST only until the
+    directory fits the byte budget (newer forensics survive — the
+    most recent incident is the one an operator still wants).
+    Returns (files_removed, bytes_removed).  This is the ONLY place
+    quarantined forensics are deleted — on operator request
+    (`dn quarantine clean [--max-bytes N]`) or the serve scrub
+    timer's DN_QUARANTINE_MAX_MB budget."""
+    entries = quarantine_entries(indexroot)
+    total = sum(e[1] for e in entries)
     removed = 0
     freed = 0
-    for name, size, age_s, path in quarantine_entries(indexroot):
+    for name, size, age_s, path in entries:
+        if max_bytes is not None and total <= max_bytes:
+            break
         if age_s < older_than_s:
             continue
         try:
@@ -594,6 +616,7 @@ def quarantine_clean(indexroot, older_than_s=0):
             continue
         removed += 1
         freed += size
+        total -= size
     return removed, freed
 
 

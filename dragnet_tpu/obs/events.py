@@ -24,8 +24,12 @@ The optional file spill appends one JSON line per event, fsync-free
 tail, and that is the documented contract.  Name the file
 ``.dn_events*`` inside an index tree and the shard walks filter it
 like other dot-file metadata; anywhere else is litter-free by
-construction.  A spill write failure disables the spill (counted),
-never the ring.
+construction.  The spill is SIZE-BOUNDED (DN_EVENTS_FILE_MAX_MB,
+default 64; 0 disables): past the cap it rotates to ``<path>.1`` —
+one predecessor kept, so the footprint is bounded by ~2x the cap and
+a busy member's telemetry can never fill its own disk.  A spill
+write failure (including an armed/real ENOSPC at the
+``events.spill`` seam) disables the spill (counted), never the ring.
 
 Event catalog (type -> emitted by): docs/observability.md keeps the
 one-row-per-type table in sync with the emit sites.
@@ -46,6 +50,27 @@ DEFAULT_RING = 1024
 # entry per (type, key) per window; suppressed occurrences flush as
 # one aggregated `coalesced`-count entry when the window ends
 BURST_WINDOW_S = 1.0
+
+# default spill size cap (DN_EVENTS_FILE_MAX_MB): past it the file
+# rotates to `<path>.1` (one predecessor kept, both filtered as
+# `.dn_events*` durable tree metadata when spilled inside an index
+# tree) — a busy member's telemetry must never fill its own disk
+DEFAULT_SPILL_MAX_MB = 64
+
+
+def spill_max_bytes(env=None):
+    """The parsed-but-forgiving DN_EVENTS_FILE_MAX_MB spill cap in
+    BYTES (config.obs_config rejects malformed values; a live reader
+    must not crash on an env edit).  0 disables rotation."""
+    if env is None:
+        env = os.environ
+    raw = env.get('DN_EVENTS_FILE_MAX_MB')
+    if raw is None or raw == '':
+        return DEFAULT_SPILL_MAX_MB << 20
+    try:
+        return max(0, int(raw)) << 20
+    except ValueError:
+        return DEFAULT_SPILL_MAX_MB << 20
 
 
 def events_env(env=None):
@@ -71,10 +96,17 @@ class EventJournal(object):
     """The bounded ring + optional JSONL spill.  Thread-safe; reads
     (tail) and writes (record) contend on one short lock."""
 
-    def __init__(self, capacity, path=None, member=None):
+    def __init__(self, capacity, path=None, member=None,
+                 max_bytes=None):
         self.capacity = max(1, int(capacity))
         self.path = path
         self.member = member
+        # spill rotation cap (bytes; 0 = unbounded): the file rotates
+        # to `<path>.1` once an append would cross it
+        self.max_bytes = spill_max_bytes() if max_bytes is None \
+            else max(0, int(max_bytes))
+        self.rotations = 0
+        self._spill_bytes = None     # lazily stat'd current size
         self._lock = threading.Lock()
         # the spill's own lock: ring appends must never wait on disk
         # I/O (a slow spill target would otherwise serialize every
@@ -163,6 +195,7 @@ class EventJournal(object):
     def _spill(self, ent):
         if self.path is None or self._spill_dead:
             return
+        from .. import faults as mod_faults
         try:
             line = json.dumps(ent, sort_keys=True,
                               separators=(',', ':')) + '\n'
@@ -170,9 +203,28 @@ class EventJournal(object):
             # durability's latency; a crash loses the tail.  Under
             # the spill's OWN lock — ring appends never wait on disk
             with self._spill_lock:
+                # the resource-exhaustion seam: a spill failure
+                # (injected or real ENOSPC) disables the spill, never
+                # the ring — counted below
+                mod_faults.fire('events.spill')
+                if self._spill_bytes is None:
+                    try:
+                        self._spill_bytes = os.path.getsize(self.path)
+                    except OSError:
+                        self._spill_bytes = 0
+                if self.max_bytes and self._spill_bytes > 0 and \
+                        self._spill_bytes + len(line) > \
+                        self.max_bytes:
+                    # size-bounded rotation: keep exactly one
+                    # predecessor (`<path>.1`), so the spill's disk
+                    # footprint is bounded by ~2x the cap
+                    os.replace(self.path, self.path + '.1')
+                    self._spill_bytes = 0
+                    self.rotations += 1
                 with open(self.path, 'a') as f:
                     f.write(line)
-        except OSError:
+                self._spill_bytes += len(line)
+        except (OSError, mod_faults.FaultInjected):
             with self._lock:
                 self.spill_errors += 1
                 self._spill_dead = True
@@ -202,6 +254,8 @@ class EventJournal(object):
                     'buffered': len(self._ring),
                     'dropped': self.dropped,
                     'file': self.path,
+                    'file_max_bytes': self.max_bytes,
+                    'rotations': self.rotations,
                     'spill_errors': self.spill_errors}
 
 
@@ -210,7 +264,8 @@ def disabled_doc():
     shape-stable, zero storage."""
     return {'version': EVENTS_VERSION, 'enabled': False,
             'capacity': 0, 'seq': 0, 'buffered': 0, 'dropped': 0,
-            'file': None, 'spill_errors': 0}
+            'file': None, 'file_max_bytes': 0, 'rotations': 0,
+            'spill_errors': 0}
 
 
 # -- module-global journal (the emit sites' target) -------------------------

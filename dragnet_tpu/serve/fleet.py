@@ -84,6 +84,14 @@ def _member_row(name, st, latency=None):
         'history': bool(hist.get('enabled')),
         'events': bool((st.get('events') or {}).get('enabled')),
     }
+    res = st.get('resources') or {}
+    if res:
+        # resource governance: the member's disk mode and headroom
+        # ride the fleet view — a read-only member is the first thing
+        # an operator needs to see during a disk incident
+        row['disk_mode'] = res.get('mode')
+        row['disk_free_pct'] = res.get('free_pct')
+        row['degraded_ro'] = bool(res.get('read_only'))
     # per-member latency: this member's own op histograms merged
     if latency is not None and latency.total:
         row['p50_ms'] = round(latency.quantile(0.50), 3)
@@ -352,6 +360,14 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
         'members_draining': sum(
             1 for n in up if members[n].get('draining') or
             members[n].get('leaving')),
+        # disk governance rollup: read-only members and the fleet's
+        # tightest free-space margin (None when no member reports)
+        'members_read_only': sum(
+            1 for n in up if members[n].get('degraded_ro')),
+        'min_disk_free_pct': min(
+            (members[n]['disk_free_pct'] for n in up
+             if members[n].get('disk_free_pct') is not None),
+            default=None),
         'unreachable': unreachable,
         'complete': not unreachable,
         'fetch_timeout_s': timeout_s,
@@ -382,6 +398,11 @@ def fleet_prometheus_text(doc):
     reg.set_gauge('fleet_members_unreachable',
                   len(doc['unreachable']))
     reg.set_gauge('fleet_epoch_skew', doc['epoch_skew'])
+    reg.set_gauge('fleet_members_read_only',
+                  doc.get('members_read_only') or 0)
+    if doc.get('min_disk_free_pct') is not None:
+        reg.set_gauge('fleet_min_disk_free_pct',
+                      doc['min_disk_free_pct'])
     if doc.get('epoch') is not None:
         reg.set_gauge('fleet_epoch', doc['epoch'])
     agg = doc['aggregate']

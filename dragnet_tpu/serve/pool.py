@@ -215,10 +215,17 @@ class PooledConn(object):
             frame = mod_protocol.encode_request(req, rid)
             mod_faults.fire('client.send')
             with self._wlock:
-                self.sock.sendall(frame)
                 if not self._confirmed_v2:
+                    # record wire order BEFORE the bytes leave: a
+                    # fast v1 peer can answer and EOF before this
+                    # thread runs again, and _deliver_v1 must find
+                    # the rid or the response is dropped on the
+                    # floor (a stale entry from a failed send is
+                    # harmless — _deliver_v1 skips rids with no
+                    # parked waiter)
                     with self._lock:
                         self._sent_order.append(rid)
+                self.sock.sendall(frame)
             sent_at = time.monotonic()
             mod_faults.fire('client.recv')
             if not w.event.wait(timeout_s):

@@ -256,7 +256,7 @@ class HandoffPuller(object):
     op the coordinator polls for commit readiness."""
 
     def __init__(self, committed, pending, member, topo_conf=None,
-                 log=None):
+                 log=None, governor=None):
         if topo_conf is None:
             topo_conf = mod_config.topo_config()
         if isinstance(topo_conf, DNError):
@@ -267,6 +267,13 @@ class HandoffPuller(object):
         self.target_epoch = pending.epoch
         self.conf = topo_conf
         self.log = log
+        # resource governance (resources.py): handoff fetches are
+        # background disk consumers — low pressure PAUSES the pull
+        # (resumes when space frees), critical fails it with the
+        # clean retryable disk_full error (the topology watcher's
+        # retry_failed_handoff restarts it every poll, so recovery
+        # is automatic there too)
+        self.governor = governor
         self.ready = False
         self.failed = False
         self.error = None
@@ -474,6 +481,7 @@ class HandoffPuller(object):
             for rel, size, crc, donors, dest in needed:
                 if self._stale.is_set():
                     return missing
+                self._wait_writable()
                 if self._fetch_shard(dsname, cfg_path, rel, size,
                                      crc, donors, dest,
                                      timeout_s, retries,
@@ -495,6 +503,31 @@ class HandoffPuller(object):
             if not missing:
                 self.affected_pids = affected
         return missing
+
+    def _wait_writable(self):
+        """The per-shard resource gate: hold the pull while the
+        governor reports low pressure (stop/stale still interrupt
+        instantly), and fail it cleanly — retryable disk_full — once
+        the disk goes critical: streaming more shards onto a full
+        disk can only make the incident worse."""
+        gov = self.governor
+        if gov is None:
+            return
+        from .. import resources as mod_resources
+        paused = False
+        while not self._stale.is_set() and gov.mode() == 'low':
+            if not paused:
+                paused = True
+                obs_events.emit_burst('resource.paused',
+                                      key='handoff',
+                                      component='handoff')
+                obs_metrics.inc('resource_paused_total',
+                                component='handoff')
+                if self.log is not None:
+                    self.log.info('handoff pull paused: disk low')
+            self._stale.wait(0.5)
+        if not self._stale.is_set() and gov.is_read_only():
+            raise mod_resources.disk_full_error('handoff pull')
 
     def _fetch_shard(self, dsname, cfg_path, rel, size, crc, donors,
                      dest, timeout_s, retries, indexroot):

@@ -212,6 +212,10 @@ class MemberState(object):
         self.breaker = breaker
         self.lock = threading.Lock()
         self.draining = False
+        # disk-critical read-only member (resources.py): still
+        # serving queries byte-identically, demoted only for
+        # write-shaped dispatch
+        self.degraded_ro = False
         self.last_ok = None        # monotonic of last good signal
         # set when the member leaves the topology: its prober thread
         # exits at the next wakeup instead of probing a dead endpoint
@@ -222,6 +226,7 @@ class MemberState(object):
         ok = bool(doc.get('ok'))
         with self.lock:
             self.draining = bool(doc.get('draining'))
+            self.degraded_ro = bool(doc.get('degraded_ro'))
             if ok:
                 self.last_ok = time.monotonic()
         if ok:
@@ -232,9 +237,11 @@ class MemberState(object):
     def snapshot(self):
         with self.lock:
             draining = self.draining
+            degraded_ro = self.degraded_ro
             last_ok = self.last_ok
         snap = self.breaker.snapshot()
         snap.update({'endpoint': self.endpoint, 'draining': draining,
+                     'degraded_ro': degraded_ro,
                      'last_ok_age_s':
                      round(time.monotonic() - last_ok, 3)
                      if last_ok is not None else None})
@@ -307,7 +314,7 @@ class Router(object):
     self replica demotes exactly like a remote draining member."""
 
     def __init__(self, topology, member, conf=None, local_exec=None,
-                 self_draining=None):
+                 self_draining=None, self_degraded=None):
         if conf is None:
             conf = mod_config.router_config()
         if isinstance(conf, DNError):
@@ -317,6 +324,9 @@ class Router(object):
         self.conf = conf
         self.local_exec = local_exec
         self.self_draining = self_draining or (lambda: False)
+        # the local server's read-only (disk critical) state, the
+        # self-member analog of a probed degraded_ro flag
+        self.self_degraded = self_degraded or (lambda: False)
         self.states = {}
         for name in topology.member_names():
             self.states[name] = MemberState(
@@ -485,31 +495,45 @@ class Router(object):
 
     # -- replica ranking --------------------------------------------------
 
-    def _rank(self, replicas):
+    def _rank(self, replicas, write_shaped=False):
         """Dispatch preference: healthy members first (self preferred
         — a local partial never pays the socket), draining members
-        demoted, open-breaker members last-resort.  Returns the full
-        list — a last-resort member is still better than a degraded
-        response."""
+        demoted, open-breaker members last-resort.  `write_shaped`
+        additionally demotes read-only (disk-critical ``degraded_ro``)
+        members: they keep serving queries byte-identically, so READ
+        dispatch ranks them exactly like healthy members, but a
+        write-shaped op would only bounce off their disk_full
+        rejection.  Returns the full list — a last-resort member is
+        still better than a degraded response."""
         def score(name):
             st = self.states.get(name)
             if st is None:
                 # left the topology mid-scatter: worst rank, and the
                 # dial itself fails cleanly into the failover path
-                return (3, 1, replicas.index(name))
+                return (4, 1, replicas.index(name))
             snap = st.breaker.snapshot()
             with st.lock:
                 draining = st.draining
+                degraded_ro = st.degraded_ro
             if name == self.member:
                 draining = draining or self.self_draining()
+                degraded_ro = degraded_ro or self.self_degraded()
             penalty = 0
             if draining:
+                penalty += 1
+            if write_shaped and degraded_ro:
                 penalty += 1
             if snap['state'] == Breaker.OPEN:
                 penalty += 2
             return (penalty, 0 if name == self.member else 1,
                     replicas.index(name))
         return sorted(replicas, key=score)
+
+    def rank_for_write(self, replicas):
+        """Replica preference for write-shaped dispatch (remote
+        builds, repair/handoff landing targets): read-only members
+        rank behind writable ones."""
+        return self._rank(replicas, write_shaped=True)
 
     # -- partial fetch ----------------------------------------------------
 

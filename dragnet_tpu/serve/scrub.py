@@ -120,7 +120,31 @@ class RepairManager(object):
         self._wake.set()
 
     def _run(self):
+        paused = False
         while not self._stop.is_set():
+            # resource governance: repair pulls are BACKGROUND disk
+            # consumers — under low/critical pressure queued work
+            # stays queued (resuming automatically when the governor
+            # recovers) instead of filling the last free bytes the
+            # serving path needs.  Only pause when there IS work: an
+            # idle worker under a long pressure window must not emit
+            # a pause event per second for the whole incident.
+            gov = getattr(self.server, 'governor', None)
+            with self._lock:
+                has_work = bool(self._queue)
+            if has_work and gov is not None and gov.mode() != 'ok':
+                if not paused:
+                    paused = True
+                    obs_events.emit('resource.paused',
+                                    component='repair')
+                    obs_metrics.inc('resource_paused_total',
+                                    component='repair')
+                # pace on the STOP event (the wake event may already
+                # be set by a schedule(); waiting on it here would
+                # spin) — stop still interrupts the pause instantly
+                self._stop.wait(1.0)
+                continue
+            paused = False
             with self._lock:
                 item = self._queue.popleft() if self._queue else None
             if item is None:
@@ -164,6 +188,14 @@ class RepairManager(object):
         topo = server.cluster           # committed snapshot
         if topo is None:
             return False
+        # the resource-exhaustion seam (and the read-only gate: a
+        # repair LANDS bytes — on a disk-critical member that write
+        # is refused like any other until space frees)
+        from .. import faults as mod_faults
+        mod_faults.fire('repair.land')
+        gov = getattr(server, 'governor', None)
+        if gov is not None:
+            gov.check_writable('shard repair')
         expected = mod_integrity.load_catalog(indexroot).get(rel)
         if expected is None:
             self._bump('no_catalog')
@@ -345,6 +377,8 @@ class ScrubThread(object):
         self.runs = 0
         self.last = None
         self.last_error = None
+        self.quarantine_evicted_files = 0
+        self.quarantine_evicted_bytes = 0
         self._thread = threading.Thread(
             target=self._run, name='dn-scrub', daemon=True)
 
@@ -360,11 +394,43 @@ class ScrubThread(object):
             return {'interval_s': self.interval_s,
                     'rate_bytes_s': self.rate_bytes_s,
                     'runs': self.runs, 'last': self.last,
+                    'quarantine_evicted_files':
+                    self.quarantine_evicted_files,
+                    'quarantine_evicted_bytes':
+                    self.quarantine_evicted_bytes,
                     'last_error': self.last_error}
+
+    def _enforce_quarantine_budget(self):
+        """The DN_QUARANTINE_MAX_MB auto-clean hook: after each scrub
+        pass, evict the OLDEST quarantined forensics past the byte
+        budget so quarantined corruption can never fill the disk it
+        was saved from.  0 (the default) keeps the manual-only
+        `dn quarantine clean` contract."""
+        max_mb = self.server.integrity_conf.get('quarantine_max_mb',
+                                                0)
+        if not max_mb:
+            return
+        budget = max_mb << 20
+        for dsname, ds in member_datasources(self.server):
+            n, b = mod_integrity.quarantine_clean(
+                ds.ds_indexpath, max_bytes=budget)
+            if not n:
+                continue
+            with self._lock:
+                self.quarantine_evicted_files += n
+                self.quarantine_evicted_bytes += b
+            obs_metrics.inc('quarantine_evicted_total', n)
+            obs_metrics.inc('quarantine_evicted_bytes_total', b)
+            obs_events.emit('quarantine.evicted', ds=dsname,
+                            files=n, bytes=b)
+            if self.log is not None:
+                self.log.info('quarantine budget enforced',
+                              ds=dsname, files=n, bytes=b)
 
     def _run(self):
         while not self._stop.wait(self.interval_s):
             try:
+                self._enforce_quarantine_budget()
                 doc = scrub_member(self.server, repair=True,
                                    rate_bytes_s=self.rate_bytes_s)
                 with self._lock:

@@ -63,6 +63,7 @@ from .. import cli as mod_cli
 from .. import config as mod_config
 from .. import faults as mod_faults
 from .. import integrity as mod_integrity
+from .. import resources as mod_resources
 from .. import vpipe as mod_vpipe
 from .. import index_query_mt as mod_iqmt
 from .. import log as mod_log
@@ -286,7 +287,8 @@ class DnServer(object):
             self.router = mod_router.Router(
                 cluster, member, conf=router_conf,
                 local_exec=self._local_partial,
-                self_draining=lambda: self.draining)
+                self_draining=lambda: self.draining,
+                self_degraded=lambda: self.governor.is_read_only())
         self.socket_path = socket_path
         self.port = port
         self.host = host
@@ -300,6 +302,17 @@ class DnServer(object):
         if isinstance(integ_conf, DNError):
             raise integ_conf
         self.integrity_conf = integ_conf
+        # resource governance (resources.py): disk watermarks drive
+        # explicit low/critical modes (background consumers pause,
+        # then the member flips read-only while queries keep serving
+        # byte-identically); the memory budget sheds over-footprint
+        # admissions with retry hints
+        res_conf = mod_config.resources_config()
+        if isinstance(res_conf, DNError):
+            raise res_conf
+        self._resource_paths_memo = (None, 0.0)
+        self.governor = mod_resources.ResourceGovernor(
+            res_conf, paths=self._resource_paths, member=member)
         from . import scrub as mod_scrub
         self.repair = mod_scrub.RepairManager(self)
         self.scrubber = None
@@ -407,6 +420,10 @@ class DnServer(object):
         # dedupes their identical tails)
         if obs_events.journal() is None:
             obs_events.install(member=self.member)
+        # the resource governor polls in the background so gauges and
+        # mode transitions stay fresh even on an idle server, and
+        # recovery from critical is automatic with no request traffic
+        self.governor.start()
         hist_s = obs_history.history_interval_s()
         if hist_s > 0:
             self.history = obs_history.HistorySnapshotter(
@@ -474,6 +491,7 @@ class DnServer(object):
             self.history.stop()
         if self.scrubber is not None:
             self.scrubber.stop()
+        self.governor.stop()
         self.repair.stop()
         if self.puller is not None:
             self.puller.stop()
@@ -573,7 +591,8 @@ class DnServer(object):
             self.puller.stop()
         self.puller = mod_rebalance.HandoffPuller(
             committed, pending, self.member,
-            topo_conf=self.topo_conf, log=self.log).start()
+            topo_conf=self.topo_conf, log=self.log,
+            governor=self.governor).start()
 
     def retry_failed_handoff(self):
         """Restart a FAILED pull for the still-pending epoch (the
@@ -706,6 +725,38 @@ class DnServer(object):
         with self._stats_lock:
             self._counters[name] = self._counters.get(name, 0) + n
 
+    def _resource_paths(self):
+        """Index roots the resource governor watches (30s-memoized:
+        resolving them loads the member config, which must not run
+        once per 2s poll)."""
+        paths, at = self._resource_paths_memo
+        now = time.monotonic()
+        if paths is not None and now - at < 30.0:
+            return paths
+        paths = []
+        try:
+            from . import scrub as mod_scrub
+            for dsname, ds in mod_scrub.member_datasources(self):
+                paths.append(ds.ds_indexpath)
+        except Exception:
+            pass
+        self._resource_paths_memo = (paths, now)
+        return paths
+
+    def _admit_resources(self, op, ds):
+        """Memory-budget admission (resources.py): reserve the
+        request's estimated footprint for its lifetime; an
+        over-budget request sheds through the PR 10 OverloadedError
+        path with an honest retry hint.  Returns the lease (release
+        exactly-or-more-than once)."""
+        try:
+            return self.governor.admit_request(op, ds)
+        except mod_resources.MemoryBudgetError as e:
+            obs_metrics.inc('serve_shed_total', reason='memory')
+            raise mod_admission.OverloadedError(
+                e.message,
+                retry_after_ms=self.admission.retry_after_ms())
+
     def _quarantine_usage(self):
         """The quarantine_bytes/quarantine_files gauges for /stats
         `recovery`: `.dn_quarantine/` is moved-into by every
@@ -790,6 +841,10 @@ class DnServer(object):
                 'signals': {k: counters.get(k, 0)
                             for k in _DEVICE_SIGNALS},
             },
+            # resource governance (resources.py): mode, per-tree
+            # disk view, fd headroom, memory-budget accounting,
+            # transition counters
+            'resources': self.governor.stats_doc(),
             # chaos/recovery observability: per-site injection
             # telemetry (empty unless DN_FAULTS armed) and the
             # crash-recovery counters (index_journal)
@@ -1025,13 +1080,21 @@ class DnServer(object):
             # — but stays ok (healthy, still serving) so the breaker
             # never churns on an orderly departure
             leaving = self._topo_leaving_now()
+            # a read-only member (disk critical) stays ok — queries
+            # keep serving byte-identically, the breaker must not
+            # churn — but reports degraded_ro so routers rank it
+            # down for write-shaped ops
+            degraded_ro = self.governor.is_read_only()
             doc = {
                 'ok': not self.draining,
                 'draining': self.draining or leaving,
+                'degraded_ro': degraded_ro,
                 'pid': os.getpid(),
                 'uptime_s': round(time.monotonic() - self._t0, 3),
                 'inflight': self.admission.depth(),
             }
+            if degraded_ro:
+                doc['health'] = 'degraded_ro'
             if self.cluster is not None:
                 doc['member'] = self.member
                 doc['epoch'] = self.cluster.epoch
@@ -1280,6 +1343,11 @@ class DnServer(object):
                                     req.get('ds'), iroot, shards)
                             except Exception:
                                 pass
+                    if getattr(e, 'disk_full', False):
+                        # the read-only rejection (resources.py):
+                        # the header names it so clients/routers can
+                        # classify — and retry elsewhere or later
+                        flags['disk_full'] = True
                     if getattr(e, 'retryable', False):
                         flags['retryable_error'] = True
                         # degraded-because-shedding: the members'
@@ -1415,6 +1483,11 @@ class DnServer(object):
             extra['epoch_mismatch'] = True
             if flags.get('current_epoch') is not None:
                 extra['current_epoch'] = flags['current_epoch']
+        if flags.get('disk_full'):
+            # the read-only signal: this member is out of disk and
+            # rejecting write-shaped ops until space frees (queries
+            # still serve) — retry against another member or later
+            extra['disk_full'] = True
         if flags.get('corrupt_shard') is not None:
             # the self-healing signal: this member quarantined (or is
             # missing) the named shard and is repairing in the
@@ -1505,9 +1578,17 @@ class DnServer(object):
             req, _config_ident(backend.cbl_path))
 
         def compute():
-            slot = flags['slot'] = self.admission.acquire(
-                tenant=flags.get('tenant'),
-                deadline_at=flags.get('deadline_at'))
+            lease = self._admit_resources(op, ds)
+            try:
+                slot = flags['slot'] = self.admission.acquire(
+                    tenant=flags.get('tenant'),
+                    deadline_at=flags.get('deadline_at'))
+            except BaseException:
+                # a busy/draining/shed rejection must hand the
+                # reserved footprint back — a leaked lease would
+                # ratchet the budget shut for the process lifetime
+                lease.release()
+                raise
             flags['exec_t0'] = time.monotonic()
             try:
                 with obs_trace.span('serve.execute', op=op):
@@ -1522,6 +1603,7 @@ class DnServer(object):
                                         dry_run=opts.dry_run)
             finally:
                 slot.release()
+                lease.release()
 
         try:
             result, shared = self.coalescer.run(key, compute,
@@ -1636,9 +1718,14 @@ class DnServer(object):
 
         def compute():
             from . import router as mod_router
-            slot = flags['slot'] = self.admission.acquire(
-                tenant=flags.get('tenant'),
-                deadline_at=flags.get('deadline_at'))
+            lease = self._admit_resources('query_partial', ds)
+            try:
+                slot = flags['slot'] = self.admission.acquire(
+                    tenant=flags.get('tenant'),
+                    deadline_at=flags.get('deadline_at'))
+            except BaseException:
+                lease.release()
+                raise
             flags['exec_t0'] = time.monotonic()
             try:
                 with self._tree_lock(ds, dsname).read(), \
@@ -1648,6 +1735,7 @@ class DnServer(object):
                         ds, query, interval, serving, pids)
             finally:
                 slot.release()
+                lease.release()
 
         try:
             shards, shared = self.coalescer.run(key, compute,
@@ -1769,8 +1857,14 @@ class DnServer(object):
         deadline_ms = partial_req.get('deadline_ms')
         deadline_at = time.monotonic() + deadline_ms / 1000.0 \
             if deadline_ms and deadline_ms > 0 else None
-        slot = self.admission.acquire(
-            tenant=partial_req.get('tenant'), deadline_at=deadline_at)
+        lease = self._admit_resources('query_partial', ds)
+        try:
+            slot = self.admission.acquire(
+                tenant=partial_req.get('tenant'),
+                deadline_at=deadline_at)
+        except BaseException:
+            lease.release()
+            raise
         try:
             with self._tree_lock(ds, dsname).read():
                 return mod_router.partial_query(
@@ -1790,6 +1884,7 @@ class DnServer(object):
             raise
         finally:
             slot.release()
+            lease.release()
 
     def _run_build(self, req, ds, config, dsname, opts,
                    metrics_for_index, flags):
@@ -1808,9 +1903,20 @@ class DnServer(object):
         if len(metrics) == 0:
             mod_cli.fatal(DNError('no metrics defined for dataset '
                                   '"%s"' % dsname))
-        slot = flags['slot'] = self.admission.acquire(
-            tenant=flags.get('tenant'),
-            deadline_at=flags.get('deadline_at'))
+        # the read-only gate: a disk-critical member rejects builds
+        # up front with the clean retryable disk_full DNError (the
+        # job() handler marks the response header) — queries keep
+        # serving byte-identically throughout
+        if not opts.dry_run:
+            self.governor.check_writable('build')
+        lease = self._admit_resources('build', ds)
+        try:
+            slot = flags['slot'] = self.admission.acquire(
+                tenant=flags.get('tenant'),
+                deadline_at=flags.get('deadline_at'))
+        except BaseException:
+            lease.release()
+            raise
         flags['exec_t0'] = time.monotonic()
         try:
             with self._tree_lock(ds, dsname).write(), \
@@ -1821,9 +1927,15 @@ class DnServer(object):
                                   dry_run=opts.dry_run,
                                   warn_func=None)
         except DNError as e:
+            if getattr(e, 'retryable', False):
+                # a mid-build pressure failure keeps its disk_full /
+                # retryable attributes for the response header;
+                # fatal() would strip both
+                raise
             mod_cli.fatal(e)
         finally:
             slot.release()
+            lease.release()
         if opts.dry_run:
             mod_cli.dn_output(None, opts, result, dsname)
             return 0

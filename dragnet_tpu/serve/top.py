@@ -44,8 +44,12 @@ def _member_state(row):
         return 'leaving'
     if row.get('draining'):
         return 'draining'
+    if row.get('degraded_ro'):
+        return 'read-only'       # disk critical: still serving reads
     if row.get('pending_epoch'):
         return 'handoff'
+    if row.get('disk_mode') == 'low':
+        return 'disk-low'
     return 'up'
 
 
@@ -83,6 +87,15 @@ def render_frame(doc, ansi=True):
         lines.append('repair queued %d completed %d failed %d'
                      % (rp.get('queued', 0), rp.get('completed', 0),
                         rp.get('failed', 0)))
+    if doc.get('members_read_only'):
+        lines.append('%sDISK: %d member(s) read-only (min free %s%%)'
+                     '%s'
+                     % (b, doc['members_read_only'],
+                        _fmt(doc.get('min_disk_free_pct')), r))
+    elif doc.get('min_disk_free_pct') is not None and \
+            doc['min_disk_free_pct'] < 15:
+        lines.append('disk: min free %s%%'
+                     % _fmt(doc['min_disk_free_pct']))
     lines.append('')
 
     cols = ('member', 'state', 'epoch', 'qps', 'p50', 'p95',

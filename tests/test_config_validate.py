@@ -235,7 +235,8 @@ def test_obs_config_parses_overrides(tmp_path):
     assert conf == {'trace': 'stderr', 'slow_ms': 250,
                     'buckets': [1.0, 5.0, 25.0],
                     'history_s': 0, 'events': 0,
-                    'events_file': None, 'top_interval_ms': 1000}
+                    'events_file': None, 'events_file_max_mb': 64,
+                    'top_interval_ms': 1000}
     path = str(tmp_path / 'trace.jsonl')
     conf = mod_config.obs_config(env={'DN_TRACE': path})
     assert conf['trace'] == path
@@ -365,7 +366,7 @@ def test_topo_config_rejects_bad_values():
 def test_integrity_config_defaults():
     conf = mod_config.integrity_config(env={})
     assert conf == {'verify': 'off', 'scrub_interval_s': 0,
-                    'scrub_rate_mb_s': 64}
+                    'scrub_rate_mb_s': 64, 'quarantine_max_mb': 0}
 
 
 def test_integrity_config_parses_overrides():
@@ -374,7 +375,7 @@ def test_integrity_config_parses_overrides():
         'DN_SCRUB_INTERVAL_S': '300',
         'DN_SCRUB_RATE_MB_S': '0'})
     assert conf == {'verify': 'full', 'scrub_interval_s': 300,
-                    'scrub_rate_mb_s': 0}
+                    'scrub_rate_mb_s': 0, 'quarantine_max_mb': 0}
 
 
 def test_integrity_config_rejects_bad_values():

@@ -326,7 +326,7 @@ _STATS_SHAPE = {
     'draining': bool, 'requests': dict, 'inflight': dict,
     'caches': dict, 'counters': dict, 'device': dict,
     'faults': dict, 'recovery': dict, 'metrics': dict,
-    'history': dict, 'events': dict,
+    'history': dict, 'events': dict, 'resources': dict,
 }
 
 
@@ -363,7 +363,8 @@ def test_stats_schema_golden_shape(server, corpus):
     ev = st['events']
     assert ev['version'] == obs_events_mod.EVENTS_VERSION
     assert set(ev) == {'version', 'enabled', 'capacity', 'seq',
-                       'buffered', 'dropped', 'file', 'spill_errors'}
+                       'buffered', 'dropped', 'file',
+                       'file_max_bytes', 'rotations', 'spill_errors'}
     lat = m['histograms'].get('serve_op_latency_ms{op=query}')
     assert lat is not None
     assert lat['count'] >= 1

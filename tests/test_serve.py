@@ -830,7 +830,12 @@ def test_serve_validate_ok(monkeypatch):
                    b'handoff_timeout_s=120 handoff_retries=2 '
                    b'max_moves=2\n'
                    b'integrity config ok: verify=off '
-                   b'scrub_interval_s=0 scrub_rate_mb_s=64\n')
+                   b'scrub_interval_s=0 scrub_rate_mb_s=64 '
+                   b'quarantine_max_mb=0\n'
+                   b'resources config ok: disk_low_pct=10 '
+                   b'disk_critical_pct=5 poll_ms=2000 '
+                   b'mem_budget_mb=0 fd_headroom=64 '
+                   b'events_file_max_mb=64\n')
 
 
 def test_serve_validate_reports_armed_faults(monkeypatch):

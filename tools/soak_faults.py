@@ -44,6 +44,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -2202,6 +2203,246 @@ def soak_follow(root, fast=False, verbose=True, floor=None):
     return summary
 
 
+# -- resource-exhaustion drill (disk governance + read-only serving) --------
+
+class ResourceSoak(ClusterSoak):
+    """The resource-exhaustion survival drill (`make soak-resources`):
+    a 3-member routed cluster under continuous query flood while the
+    simulated disk (DN_DISK_SIM_FILE) is forced through a full
+    low -> critical -> recovered cycle, plus enospc/emfile faults
+    armed at every write seam.  The contract:
+
+    * queries stay BYTE-IDENTICAL to the single-process goldens
+      through every mode, including the read-only window;
+    * during critical, builds reject on every member with the clean
+      retryable `disk full` error (header disk_full, never a
+      traceback) and health reports degraded_ro;
+    * recovery is automatic: once space frees, builds succeed again
+      with no restart;
+    * armed enospc/emfile at each write seam leaves a recoverable
+      tree — zero torn shards, zero stranded tmps."""
+
+    def __init__(self, ctx, verbose=True):
+        super(ResourceSoak, self).__init__(ctx, verbose=verbose)
+        self.sim_path = os.path.join(ctx['root'], 'disk_sim')
+        self._flood_stop = None
+        self._flood_threads = []
+
+    # -- the simulated disk -------------------------------------------
+
+    def set_free_pct(self, pct):
+        with open(self.sim_path + '.w', 'w') as f:
+            f.write('%g\n' % pct)
+        os.replace(self.sim_path + '.w', self.sim_path)
+
+    def wait_mode(self, mode, timeout_s=30.0):
+        """Block until every member reports `mode` (in-process
+        governors directly; subprocess b via its health op, which
+        only distinguishes read-only)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            ok = all(srv.governor.mode() == mode
+                     for srv in self.servers.values())
+            if ok and mode in ('ok', 'critical'):
+                doc = mod_client.health(self.socks['b'],
+                                        timeout_s=2.0)
+                want_ro = mode == 'critical'
+                ok = doc.get('ok') and \
+                    bool(doc.get('degraded_ro')) == want_ro
+            if ok:
+                return True
+            time.sleep(0.1)
+        self.violate('members never reached resource mode %r' % mode)
+        return False
+
+    # -- flood --------------------------------------------------------
+
+    def start_flood(self, nthreads=2):
+        self._flood_stop = threading.Event()
+
+        def worker(tid):
+            i = tid
+            while not self._flood_stop.is_set():
+                fmt = FORMATS[i % len(FORMATS)]
+                ds = self.ctx['ds'][fmt]
+                cases = query_cases(ds)
+                case = cases[i % len(cases)]
+                via = 'abc'[i % 3]
+                got = run_cli(case[:1] +
+                              ['--remote', self.socks[via]] +
+                              case[1:])
+                self.check_routed(fmt, case, got)
+                i += nthreads
+
+        self._flood_threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(nthreads)]
+        for t in self._flood_threads:
+            t.start()
+
+    def stop_flood(self):
+        if self._flood_stop is not None:
+            self._flood_stop.set()
+        for t in self._flood_threads:
+            t.join(60)
+            if t.is_alive():
+                self.violate('resource drill: flood thread hung')
+        self._flood_threads = []
+
+    # -- checks -------------------------------------------------------
+
+    def read_only_byte_identity(self):
+        """The read-only window's core contract: every query case
+        through every member must SUCCEED byte-identically while
+        builds are rejected."""
+        for fmt in FORMATS:
+            ds = self.ctx['ds'][fmt]
+            for i, case in enumerate(query_cases(ds)):
+                via = 'abc'[i % 3]
+                got = run_cli(case[:1] +
+                              ['--remote', self.socks[via]] +
+                              case[1:])
+                self.check_routed(fmt, case, got, degraded_ok=False)
+
+    def build_remote(self, member, fmt):
+        return run_cli(['build', self.ctx['ds'][fmt], '--remote',
+                        self.socks[member]],
+                       env={'DN_INDEX_FORMAT': fmt,
+                            'DN_REMOTE_RETRIES': '0'})
+
+    def check_builds(self, expect_ok, when):
+        for member in 'abc':
+            fmt = FORMATS[ord(member) % len(FORMATS)]
+            rc, out, err = self.build_remote(member, fmt)
+            self.ops += 1
+            text = err.decode('utf-8', 'replace')
+            if 'Traceback' in text:
+                self.violate('build via %s %s: traceback: %r'
+                             % (member, when, text[-300:]))
+            elif expect_ok and rc != 0:
+                self.violate('build via %s %s: rejected: %r'
+                             % (member, when, text[-300:]))
+            elif not expect_ok:
+                if rc == 0:
+                    self.violate('build via %s %s: succeeded on a '
+                                 'read-only member' % (member, when))
+                elif 'disk full' not in text:
+                    self.violate('build via %s %s: rejection does '
+                                 'not name disk full: %r'
+                                 % (member, when, text[-300:]))
+                else:
+                    self.clean_errors += 1
+
+    def check_stats_surface(self):
+        """/stats must carry the resources section and the governor
+        gauges must ride the Prometheus exposition."""
+        self.ops += 1
+        doc = mod_client.stats(self.socks['a'], timeout_s=30.0)
+        res = doc.get('resources') or {}
+        if res.get('mode') not in ('ok', 'low', 'critical'):
+            self.violate('/stats resources section missing or '
+                         'malformed: %r' % (res,))
+        rc, out, err = run_cli(['stats', '--prom', '--remote',
+                                self.socks['a']])
+        if rc != 0 or b'disk_mode' not in out or \
+                b'disk_free_bytes' not in out:
+            self.violate('resource gauges missing from the '
+                         'Prometheus exposition')
+
+    def enospc_seam_drills(self):
+        """enospc/emfile at rate 1.0, seam by seam: every local build
+        must fail CLEAN (no traceback), leave zero stranded tmps once
+        superseded, and a disarmed rebuild must succeed."""
+        specs = ('sink.create:emfile:1.0',
+                 'sink.flush:enospc:1.0',
+                 'sink.rename:enospc:1.0',
+                 'journal.commit:enospc:1.0',
+                 'integrity.catalog:enospc:1.0')
+        for spec in specs:
+            for fmt in FORMATS:
+                mod_faults.reset()
+                rc, out, err = run_cli(
+                    ['build', self.ctx['ds'][fmt]],
+                    env={'DN_INDEX_FORMAT': fmt, 'DN_FAULTS': spec})
+                self.ops += 1
+                text = err.decode('utf-8', 'replace')
+                if rc == 0:
+                    self.violate('%s %s: build succeeded with the '
+                                 'seam armed at 1.0' % (fmt, spec))
+                elif 'Traceback' in text or 'dn:' not in text:
+                    self.violate('%s %s: unclean resource failure: '
+                                 '%r' % (fmt, spec, text[-300:]))
+                else:
+                    self.clean_errors += 1
+            mod_faults.reset()
+        self.check_trees('enospc seam drills')
+
+
+def soak_resources(root, fast=False, verbose=True, floor=None):
+    """The resource-exhaustion drill under `root`; returns the
+    summary dict."""
+    mod_faults.reset()
+    sim_path = os.path.join(root, 'disk_sim')
+    with open(sim_path, 'w') as f:
+        f.write('60\n')
+    os.environ.update({
+        'DN_DISK_SIM_FILE': sim_path,
+        'DN_RESOURCE_POLL_MS': '100',
+        # the fd table of a soak process (pools, members, spools) is
+        # noise here — the disk cycle is the drill
+        'DN_FD_HEADROOM': '0',
+        'DN_ROUTER_PROBE_MS': '150',
+        'DN_EVENTS': '4096'})
+    ctx = make_corpus(root, n=400 if fast else 1200,
+                      days=5 if fast else 10)
+    for fmt in FORMATS:
+        build(ctx, fmt)
+    s = ResourceSoak(ctx, verbose=verbose)
+    s.start_cluster()
+    try:
+        s.note('flood up; baseline byte-identity + builds (mode ok)')
+        s.start_flood(nthreads=2)
+        s.read_only_byte_identity()
+        s.check_builds(expect_ok=True, when='at mode ok')
+        s.note('forcing disk low (8% free)')
+        s.set_free_pct(8)
+        s.wait_mode('low')
+        # low pauses BACKGROUND consumers only: foreground builds
+        # and queries must be untouched
+        s.read_only_byte_identity()
+        s.check_builds(expect_ok=True, when='at mode low')
+        s.note('forcing disk critical (2% free): read-only window')
+        s.set_free_pct(2)
+        s.wait_mode('critical')
+        s.read_only_byte_identity()
+        s.check_builds(expect_ok=False, when='at mode critical')
+        s.check_stats_surface()
+        s.note('freeing space: automatic recovery')
+        s.set_free_pct(60)
+        s.wait_mode('ok')
+        s.read_only_byte_identity()
+        s.check_builds(expect_ok=True, when='after recovery')
+        s.stop_flood()
+        s.note('enospc/emfile write-seam drills')
+        s.enospc_seam_drills()
+        if floor:
+            extra = 0
+            while extra < 60:
+                total = mod_vpipe.global_counters().get(
+                    'faults injected', 0)
+                if total >= floor:
+                    break
+                extra += 1
+                s.note('top-up seam round %d (%d/%d faults)'
+                       % (extra, total, floor))
+                s.enospc_seam_drills()
+        s.check_trees('resource drill')
+    finally:
+        s.stop_flood()
+        s.stop_cluster()
+    return s.summary()
+
+
 # the in-process mixed-fault spec: every site that can fire without
 # killing the soak process (kill/torn run under the subprocess drills)
 LOCAL_SPEC = ('sink.create:error:0.08:11,sink.flush:error:0.08:12,'
@@ -2281,6 +2522,15 @@ def main(argv=None):
                         'handoff/topology faults and mid-handoff '
                         'SIGKILLs) instead of the single-process '
                         'soak')
+    p.add_argument('--resources', action='store_true',
+                   help='run the resource-exhaustion drill (forced '
+                        'low->critical->recovered disk cycle under '
+                        'routed flood via DN_DISK_SIM_FILE, builds '
+                        'rejected read-only with queries '
+                        'byte-identical, automatic write '
+                        'resumption, enospc/emfile armed at every '
+                        'write seam) instead of the single-process '
+                        'soak')
     p.add_argument('--scrub', action='store_true',
                    help='run the corruption/self-healing drill '
                         '(flip bytes in committed shards across a '
@@ -2304,6 +2554,8 @@ def main(argv=None):
         default_floor = 10 if args.fast else 40
     elif args.scrub:
         default_floor = 4 if args.fast else 10
+    elif args.resources:
+        default_floor = 10 if args.fast else 20
     else:
         default_floor = 50 if args.fast else 500
     floor = args.min_faults if args.min_faults is not None \
@@ -2315,7 +2567,8 @@ def main(argv=None):
         else soak_follow if args.follow \
         else soak_overload if args.overload \
         else soak_rebalance if args.rebalance \
-        else soak_scrub if args.scrub else soak
+        else soak_scrub if args.scrub \
+        else soak_resources if args.resources else soak
     with tempfile.TemporaryDirectory(prefix='dn_soak_') as root:
         summary = runner(root, fast=args.fast, floor=floor)
     summary['elapsed_s'] = round(time.time() - t0, 1)
