@@ -6,8 +6,9 @@ PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-build bench-parse \
     bench-serve bench-cluster bench-follow bench-fanin bench-verify \
-    soak-faults soak-cluster soak-follow soak-overload \
-    soak-rebalance soak-scrub soak-resources clean parity-matrix
+    soak-faults soak-cluster soak-follow soak-compact \
+    soak-overload soak-rebalance soak-scrub soak-resources \
+    clean parity-matrix
 
 all: native
 
@@ -77,6 +78,16 @@ bench-cluster: native
 # a from-scratch build over the checkpointed prefix (docs/ingest.md)
 soak-follow: native
 	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --follow
+
+# the background-compaction drill: follow --append mini-generations
+# under remote query flood while a serve-resident compactor and
+# rollup builder rewrite the tree with compact.publish/rollup.publish
+# faults armed; subprocess dn compact/rollup SIGKILLed on both sides
+# of the commit record — every accepted response byte-equals a
+# from-scratch build and the converged tree byte-equals it shard for
+# shard (docs/robustness.md)
+soak-compact: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --compact
 
 # the continuous-ingest legs only: steady-state follow rec/s and
 # append-to-queryable latency p50/p95 (bench extras JSON)

@@ -156,6 +156,11 @@ DN_OPTIONS = [
     (['check'], 'bool', None),
     (['forget-missing'], 'bool', None),
     (['older-than'], 'string', None),
+    # `dn compact`: only rewrite base shards holding at least this
+    # many follow --append mini-generations (default 1 — fold
+    # everything).  Not in USAGE_TEXT (byte-pinned); documented in
+    # docs/robustness.md.
+    (['min-gens'], 'string', None),
     # per-run request tracing (equivalent to DN_TRACE=stderr for one
     # command; composes with --remote — the client ships its trace id
     # and grafts the server's span subtree).  Not in USAGE_TEXT: the
@@ -1729,6 +1734,78 @@ def cmd_serve(ctx, argv):
         fatal(e)
 
 
+def cmd_rollup(ctx, argv):
+    """`dn rollup [--tree T] [--interval hour|day]`: build/refresh
+    the multi-resolution rollup shards (day-from-hour, month-from-
+    day/hour; rollup.py) for the interval's fine tree — merging
+    EXISTING index shards, no raw rescan — and publish them through
+    the two-phase journal + integrity catalog.  The query planner
+    then answers wide-window queries from the coarsest covering
+    shard set, byte-identically.  Not in USAGE_TEXT — the usage
+    output is byte-pinned to the reference goldens; documented in
+    docs/serving.md."""
+    from . import rollup as mod_rollup
+    opts = dn_parse_args(argv, ['tree', 'interval'])
+    check_arg_count(opts, 0)
+    if opts.interval not in ('hour', 'day'):
+        fatal(DNError('interval not supported: "%s"' % opts.interval))
+    total = {'built': 0, 'fresh': 0, 'removed': 0}
+    for dsname, root in _integrity_trees(opts):
+        try:
+            doc = mod_rollup.build_rollups(root, opts.interval)
+        except (DNError, OSError) as e:
+            fatal(e if isinstance(e, DNError) else DNError(str(e)))
+        for k in total:
+            total[k] += doc[k]
+        if doc['paused']:
+            sys.stderr.write('dn rollup: paused under resource '
+                             'pressure (tree "%s")\n' % root)
+    sys.stderr.write('dn rollup: %d shard(s) built, %d fresh, '
+                     '%d removed\n' % (total['built'], total['fresh'],
+                                       total['removed']))
+    return 0
+
+
+def cmd_compact(ctx, argv):
+    """`dn compact [--tree T] [--interval hour|day] [--min-gens N]`:
+    rewrite base shards + their `dn follow --append` mini-generations
+    into single shards (rollup.compact_tree).  The consumed
+    generations are deleted through the publish commit record —
+    crash-safe at every instant.  Not in USAGE_TEXT (byte-pinned);
+    documented in docs/robustness.md."""
+    from . import rollup as mod_rollup
+    opts = dn_parse_args(argv, ['tree', 'interval', 'min-gens'])
+    check_arg_count(opts, 0)
+    if opts.interval not in ('hour', 'day'):
+        fatal(DNError('interval not supported: "%s"' % opts.interval))
+    min_gens = 1
+    if opts.min_gens is not None:
+        try:
+            min_gens = int(opts.min_gens)
+            if min_gens < 1:
+                raise ValueError(opts.min_gens)
+        except ValueError:
+            raise UsageError('bad value for "min-gens": "%s"'
+                             % opts.min_gens)
+    total = {'groups': 0, 'compacted': 0, 'generations_removed': 0}
+    for dsname, root in _integrity_trees(opts):
+        try:
+            doc = mod_rollup.compact_tree(root, opts.interval,
+                                          min_gens=min_gens)
+        except (DNError, OSError) as e:
+            fatal(e if isinstance(e, DNError) else DNError(str(e)))
+        for k in total:
+            total[k] += doc[k]
+        if doc['paused']:
+            sys.stderr.write('dn compact: paused under resource '
+                             'pressure (tree "%s")\n' % root)
+    sys.stderr.write('dn compact: %d group(s) compacted, %d '
+                     'generation(s) removed\n'
+                     % (total['compacted'],
+                        total['generations_removed']))
+    return 0
+
+
 COMMANDS = {
     'datasource-add': cmd_datasource_add,
     'datasource-list': cmd_datasource_list,
@@ -1744,8 +1821,10 @@ COMMANDS = {
     'index-config': cmd_index_config,
     'index-read': cmd_index_read,
     'index-scan': cmd_index_scan,
+    'compact': cmd_compact,
     'query': cmd_query,
     'quarantine': cmd_quarantine,
+    'rollup': cmd_rollup,
     'scan': cmd_scan,
     'scrub': cmd_scrub,
     'serve': cmd_serve,

@@ -343,6 +343,13 @@ _SERVE_KNOBS = [
     # (serve/fleet.py): a dead member costs the fleet view at most
     # this long and shows up as unreachable, never a hang
     ('DN_SERVE_FLEET_TIMEOUT_S', 'int', 5, 1),
+    # query-result cache byte budget (MB; serve/qcache.py): repeated
+    # identical queries answer from memory, invalidated on any index
+    # write and bounded against the SAME budget
+    # DN_SERVE_MEM_BUDGET_MB admits requests under.  0 (the default)
+    # disables the cache — byte-identical to the uncached path either
+    # way.
+    ('DN_SERVE_CACHE_MB', 'int', 0, 0),
 ]
 
 
@@ -374,8 +381,8 @@ def serve_config(env=None):
     """The resolved DN_SERVE_* knob dict (keys: max_inflight,
     queue_depth, deadline_ms, coalesce, drain_s, read_deadline_ms,
     write_deadline_ms, idle_ms, tenant_quota, tenant_default_weight,
-    tenant_weights, fleet_timeout_s), or DNError on the first
-    malformed value — 'DN_SERVE_X: expected ..., got "v"'."""
+    tenant_weights, fleet_timeout_s, cache_mb), or DNError on the
+    first malformed value — 'DN_SERVE_X: expected ..., got "v"'."""
     if env is None:
         env = os.environ
     rv = {}
@@ -589,13 +596,20 @@ _FOLLOW_KNOBS = [
     ('DN_FOLLOW_MAX_BYTES', 'int', 4 << 20, 1),
     # idle poll cadence when no source produced new bytes
     ('DN_FOLLOW_POLL_MS', 'int', 50, 1),
+    # append mode: land each batch as a mini-generation
+    # (`<shard>.sqlite-gNNNNNN`) next to its base shard instead of
+    # read-modify-rewriting the whole shard — O(batch) publishes;
+    # the background compactor (`dn compact`, DN_COMPACT_INTERVAL_S)
+    # folds generations back into one file
+    ('DN_FOLLOW_APPEND', 'bool', False, None),
 ]
 
 
 def follow_config(env=None):
     """The resolved DN_FOLLOW_* knob dict (keys: latency_ms,
-    max_bytes, poll_ms), or DNError on the first malformed value —
-    the shared fail-fast contract `dn follow --validate` checks."""
+    max_bytes, poll_ms, append), or DNError on the first malformed
+    value — the shared fail-fast contract `dn follow --validate`
+    checks."""
     if env is None:
         env = os.environ
     rv = {}
@@ -604,6 +618,12 @@ def follow_config(env=None):
         raw = env.get(name)
         if raw is None or raw == '':
             rv[key] = default
+            continue
+        if kind == 'bool':
+            if raw not in ('0', '1'):
+                return DNError('%s: expected 0 or 1, got "%s"'
+                               % (name, raw))
+            rv[key] = raw == '1'
             continue
         try:
             value = int(raw)
@@ -640,12 +660,26 @@ _SCRUB_KNOBS = [
     # disk it was saved from.  0 (the default) keeps the manual-only
     # `dn quarantine clean` contract.
     ('DN_QUARANTINE_MAX_MB', 'int', 0, 0),
+    # background rollup-build cadence in `dn serve` (rides the scrub
+    # maintenance thread): refresh day/month rollup shards from the
+    # fine tree this often.  0 (the default) disables; `dn rollup`
+    # builds on demand.
+    ('DN_ROLLUP_INTERVAL_S', 'int', 0, 1),
+    # background compaction cadence in `dn serve`: fold follow
+    # --append mini-generations back into their base shards this
+    # often.  0 (the default) disables; `dn compact` runs on demand.
+    ('DN_COMPACT_INTERVAL_S', 'int', 0, 1),
+    # generations a base shard accumulates before the background
+    # compactor bothers rewriting it (an on-demand `dn compact`
+    # always folds from 1)
+    ('DN_COMPACT_MIN_GENS', 'int', 4, 1),
 ]
 
 
 def integrity_config(env=None):
     """The resolved integrity knobs (keys: verify, scrub_interval_s,
-    scrub_rate_mb_s, quarantine_max_mb), or DNError on the first
+    scrub_rate_mb_s, quarantine_max_mb, rollup_interval_s,
+    compact_interval_s, compact_min_gens), or DNError on the first
     malformed value.
 
     * DN_VERIFY: 'off' (default — byte-identical to the unverified

@@ -1016,6 +1016,14 @@ class DatasourceFile(object):
         # stay out of the shard set
         files = [(p, st) for p, st in files
                  if not mod_journal.is_index_litter(p)]
+        if timeformat is not None:
+            # follow --append mini-generations: bounded finds
+            # enumerate exact in-window filenames and can never name
+            # a `<shard>.sqlite-gNNNNNN`; splice existing generations
+            # in after their bases (unbounded walks see them
+            # naturally)
+            from . import rollup as mod_rollup
+            files = mod_rollup.augment_generation_files(root, files)
         return root, timeformat, files
 
     def query(self, query, interval, dry_run=False):
@@ -1070,6 +1078,48 @@ class DatasourceFile(object):
                   npruned=npruned, nworkers=nworkers,
                   interval=interval)
 
+        aggr_stage = aggr.stage
+
+        def merge(items):
+            # per-shard aggregates arrive as key items (the
+            # Aggregator wire format) in emission order: write_key
+            # replays them byte-identically to re-writing the
+            # shard's points.  Counter parity with the per-point
+            # write() loop: one Index List input/output and one
+            # aggregator-stage input per point, bumped in bulk.
+            npts = len(items)
+            if npts == 0:
+                return
+            index_list.bump('ninputs', npts)
+            index_list.bump('noutputs', npts)
+            aggr_stage.bump('ninputs', npts)
+            aggr.merge_key_items(items)
+
+        # Query planner (rollup.py): serve from the coarsest covering
+        # rollup shards and fold follow mini-generations into their
+        # logical base shard.  plan_query returns None whenever the
+        # walk is plain per-file shards — the stacked/pooled paths
+        # below then run completely untouched.
+        from . import rollup as mod_rollup
+        plan = mod_rollup.plan_query(self.ds_indexpath,
+                                     interval or 'all', paths, query)
+        if plan is not None:
+            # bump_hidden mirrors into the process-global store, so
+            # `dn serve` /stats sees the fleet-wide coverage too
+            index_list.bump_hidden('index shards via rollup',
+                                   plan['ncovered'])
+            index_list.bump_hidden('rollup shards queried',
+                                   plan['nrollup'])
+
+            def query_one(path, q):
+                if nworkers <= 0:
+                    return mod_iqmt.query_shard_once(path, q)
+                return mod_iqmt._query_shard_cached(path, q)
+
+            mod_rollup.execute_plan(plan, query, query_one, merge)
+            return ScanResult(pipeline, points=aggr.points(),
+                              query=query)
+
         # Stacked cross-shard execution (index_query_stack, default):
         # shard readers only LOAD matching column blocks, and one
         # vectorized filter+group-by over the concatenated batch
@@ -1085,23 +1135,6 @@ class DatasourceFile(object):
                                           index_list)
 
         if not stacked:
-            aggr_stage = aggr.stage
-
-            def merge(items):
-                # per-shard aggregates arrive as key items (the
-                # Aggregator wire format) in emission order: write_key
-                # replays them byte-identically to re-writing the
-                # shard's points.  Counter parity with the per-point
-                # write() loop: one Index List input/output and one
-                # aggregator-stage input per point, bumped in bulk.
-                npts = len(items)
-                if npts == 0:
-                    return
-                index_list.bump('ninputs', npts)
-                index_list.bump('noutputs', npts)
-                aggr_stage.bump('ninputs', npts)
-                aggr.merge_key_items(items)
-
             mod_iqmt.run_shard_queries(paths, query, nworkers, merge)
 
         return ScanResult(pipeline, points=aggr.points(), query=query)

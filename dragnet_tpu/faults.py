@@ -96,6 +96,8 @@ SITES = (
     'integrity.catalog',  # integrity: catalog read-modify-write
     'events.spill',     # obs/events: the JSONL spill append
     'repair.land',      # serve/scrub: replica-repair shard landing
+    'rollup.publish',   # rollup: per-shard rollup build/publish
+    'compact.publish',  # rollup: compacted-group publish (pre-commit)
 )
 
 

@@ -199,7 +199,9 @@ class FollowLoop(object):
             paths = merge_publish(self.metrics, self.interval,
                                   self.indexroot, self.ds.ds_timefield,
                                   tagged, self.ckpt, new_seq, sources,
-                                  recover=recover)
+                                  recover=recover,
+                                  append=bool(
+                                      self.conf.get('append')))
         self.seq = new_seq
         self.batches += 1
         self.records += batch.nlines

@@ -193,7 +193,7 @@ def _merged_parts(path, metrics, ctxs, span, bucket_s, new_by_mi):
 
 def merge_publish(metrics, interval, indexroot, timefield, tagged,
                   checkpointer, seq, sources, nworkers=None,
-                  recover=True):
+                  recover=True, append=False):
     """Merge one batch's tagged points into the index tree and publish
     the touched shards + the post-batch checkpoint atomically.
     Returns the list of published shard paths.
@@ -203,7 +203,20 @@ def merge_publish(metrics, interval, indexroot, timefield, tagged,
     the caller KNOWS the tree is clean: FollowLoop sweeps once in
     resume() and passes recover=True only on the retry after a failed
     publish (the sole in-process way intent can be left behind on a
-    single-follower tree)."""
+    single-follower tree).
+
+    `append=True` (dn follow --append): a bucket whose base shard
+    already exists lands the batch as a mini-generation
+    (`<shard>.sqlite-gNNNNNN`, rollup.next_generation_path) instead of
+    read-modify-rewriting the whole shard — O(batch) bytes per
+    publish, no seed read.  Queries fold base+generations into one
+    logical shard and the compactor (rollup.compact_tree) rewrites
+    the group back to a single file.  A generation-number race with a
+    concurrent compactor is benign: the compactor only consumes the
+    generations it listed, a generation published after its listing
+    survives next to the compacted base, and numbering gaps are fine
+    (generation order is numeric over whatever exists).  Only hour/
+    day trees append; the 'all' shard always merges in place."""
     span, ctxs = metric_contexts(metrics, interval, timefield)
     groups = group_points(tagged, metrics, ctxs, span)
     catalog = metric_catalog_rows(metrics)
@@ -234,6 +247,7 @@ def merge_publish(metrics, interval, indexroot, timefield, tagged,
         ordered_buckets = sorted(groups)
         root = os.path.join(indexroot, 'by_' + interval)
 
+    ngens = 0
     buckets = []
     for bucket_s in ordered_buckets:
         if bucket_s is None:
@@ -243,9 +257,18 @@ def merge_publish(metrics, interval, indexroot, timefield, tagged,
             path = os.path.join(
                 root, bucket_label(bucket_s, interval) + '.sqlite')
             config = {'dn_start': bucket_s}
+        if append and bucket_s is not None and os.path.exists(path):
+            from .. import rollup as mod_rollup
+            # the generation path never exists, so _merged_parts
+            # seeds nothing: the shard holds exactly this batch's
+            # points for the bucket
+            path = mod_rollup.next_generation_path(path)
+            ngens += 1
         parts = _merged_parts(path, metrics, ctxs, span, bucket_s,
                               groups.get(bucket_s) or {})
         buckets.append((path, config, parts))
+    if ngens:
+        counter_bump('follow generations appended', ngens)
 
     paths = [p for p, config, parts in buckets]
     sinks = [None] * len(buckets)

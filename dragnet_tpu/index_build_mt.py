@@ -279,7 +279,8 @@ def _prepare_task(metrics, indexpath, config, parts, catalog, suffix,
     return task
 
 
-def publish_prepared(journal, sinks, paths, extra_paths=None):
+def publish_prepared(journal, sinks, paths, extra_paths=None,
+                     deletes=None, integrity_remove=None):
     """The commit phase shared by the block, streaming, and follow
     publishers: land the journal's commit record (THE commit point),
     rename every prepared tmp into place in bucket order, retire the
@@ -308,7 +309,15 @@ def publish_prepared(journal, sinks, paths, extra_paths=None):
     catalog after the renames — verified reads (DN_VERIFY) and `dn
     scrub` compare committed bytes against exactly what this publish
     wrote.  extra_paths (the follow checkpoint, not a shard) are
-    excluded: the catalog describes the queryable shard set."""
+    excluded: the catalog describes the queryable shard set.
+
+    `deletes` + `integrity_remove` are the compactor's supersede
+    seam: generation shards consumed by a rewrite ride the commit
+    record and are unlinked (and de-catalogued) only AFTER every
+    rename lands — a crash at any instant leaves either the full old
+    generation set or the compacted shard (possibly plus stale
+    generations the roll-forward/next pass retires), never a tree
+    missing rows."""
     from . import integrity as mod_integrity
     from .index_query_mt import shard_cache_invalidate
     from .obs import metrics as obs_metrics
@@ -320,7 +329,8 @@ def publish_prepared(journal, sinks, paths, extra_paths=None):
             tmp_for=journal.tmp_for)
         try:
             journal.record_commit(list(paths) + extra_paths,
-                                  integrity=integ)
+                                  integrity=integ, deletes=deletes,
+                                  integrity_remove=integrity_remove)
         except BaseException:
             # PRE-commit failure (e.g. ENOSPC on the record itself):
             # nothing was published, so the prepared tmps are not
@@ -353,6 +363,11 @@ def publish_prepared(journal, sinks, paths, extra_paths=None):
         if err is not None:
             raise err
         mod_integrity.record_published(integ)
+        if deletes or integrity_remove:
+            from . import index_journal as mod_journal
+            mod_journal.apply_commit_deletes({
+                'deletes': list(deletes or []),
+                'integrity_remove': dict(integrity_remove or {})})
         journal.retire()
 
 

@@ -70,14 +70,30 @@ FOLLOW_DIR = '.dn_follow'
 # not flag it as a torn artifact
 EVENTS_PREFIX = '.dn_events'
 
+# `dn follow --append`'s mini-generation shards: `<shard>-gNNNNNN`
+# next to their base shard.  The base name is a strict prefix, so a
+# sorted directory listing replays base-then-generations in publish
+# order.  rollup.py owns the naming; the journal only needs to treat
+# generation tmps as tmps.
+GEN_SEP = '-g'
+# rollup shards (day-from-hour, month-from-day) live under
+# `<indexroot>/rollup/<level>/`; the planner reads them, ordinary
+# index walks never do.  Each level carries a `.dn_rollup.json`
+# manifest naming the exact fine shards it was built from.
+ROLLUP_DIR = 'rollup'
+ROLLUP_MANIFEST = '.dn_rollup.json'
+ROLLUP_SUBDIRS = (os.path.join(ROLLUP_DIR, 'by_day'),
+                  os.path.join(ROLLUP_DIR, 'by_month'))
+
 # tmp names: `<shard>.<pid>` (legacy single-sink flushes) or
 # `<shard>.<pid>.<seq>` (journaled builds); shards are `all` or
-# `*.sqlite`, plus the follow checkpoint (`checkpoint.json.<pid>.<seq>`
-# under FOLLOW_DIR — it rides the same two-phase publish).  A
-# SIGKILLed SQLite engine additionally leaves its own
-# `-journal`/`-wal`/`-shm` sidecars next to the tmp — same litter.
+# `*.sqlite` (optionally with a `-gNNNNNN` generation suffix), plus
+# the follow checkpoint (`checkpoint.json.<pid>.<seq>` under
+# FOLLOW_DIR — it rides the same two-phase publish).  A SIGKILLed
+# SQLite engine additionally leaves its own `-journal`/`-wal`/`-shm`
+# sidecars next to the tmp — same litter.
 _TMP_RE = re.compile(
-    r'^(all|.*\.sqlite|checkpoint\.json)(\.\d+)+'
+    r'^(all|.*\.sqlite|checkpoint\.json)(-g\d+)?(\.\d+)+'
     r'(-(journal|wal|shm))?$')
 
 _SEQ_LOCK = threading.Lock()
@@ -101,7 +117,9 @@ def is_index_litter(name):
     return (base.startswith(JOURNAL_PREFIX) or
             base == QUARANTINE_DIR or
             base == FOLLOW_DIR or
+            base == ROLLUP_DIR or
             base.startswith(INTEGRITY_NAME) or
+            base.startswith(ROLLUP_MANIFEST) or
             base.startswith(EVENTS_PREFIX) or
             _TMP_RE.match(base) is not None)
 
@@ -114,7 +132,8 @@ def is_durable_metadata(name):
     (the soaks' zero-torn-shards invariant) exempt these; catalog
     `.tmp`s stay litter."""
     base = os.path.basename(name)
-    return base in (INTEGRITY_NAME, INTEGRITY_NAME + '.lock') or \
+    return base in (INTEGRITY_NAME, INTEGRITY_NAME + '.lock',
+                    ROLLUP_MANIFEST) or \
         base.startswith(EVENTS_PREFIX)
 
 
@@ -164,7 +183,8 @@ class BuildJournal(object):
     def tmp_for(self, final):
         return final + '.' + self.tmp_suffix
 
-    def record_commit(self, final_paths, integrity=None):
+    def record_commit(self, final_paths, integrity=None,
+                      deletes=None, integrity_remove=None):
         """THE commit point: atomically publish the (tmp, final) list.
         Every tmp must already be complete on disk.  After this
         record lands, the build WILL be observed (the renames below,
@@ -173,7 +193,12 @@ class BuildJournal(object):
         (integrity.integrity_entries, hashed from the prepared tmps):
         riding the commit record means the sweep's roll-forward can
         land the SAME catalog entries the in-process publish would
-        have — the catalog never disagrees with a committed tree."""
+        have — the catalog never disagrees with a committed tree.
+        `deletes` (absolute paths) names shards this publish
+        SUPERSEDES (the compactor's consumed generations): they are
+        unlinked AFTER the renames land, in-process or by the
+        roll-forward, with `integrity_remove` ({root: [relpaths]})
+        retiring their catalog entries in the same pass."""
         self.entries = [(self.tmp_for(os.path.abspath(p)),
                          os.path.abspath(p)) for p in final_paths]
         # wall clock ON PURPOSE (clock-audit, PR 7): this is a
@@ -187,6 +212,12 @@ class BuildJournal(object):
                 root: {rel: [size, crc]
                        for rel, (size, crc) in entries.items()}
                 for root, entries in integrity.items()}
+        if deletes:
+            doc['deletes'] = [os.path.abspath(p) for p in deletes]
+        if integrity_remove:
+            doc['integrity_remove'] = {
+                root: list(rels)
+                for root, rels in integrity_remove.items()}
         tmp = self.path + '.tmp'
         # a zero-bucket build never had a sink create indexroot, but
         # the commit record still lands there
@@ -216,6 +247,33 @@ class BuildJournal(object):
             os.unlink(self.path)
         except OSError:
             pass
+
+
+def apply_commit_deletes(doc):
+    """Apply a commit record's `deletes` + `integrity_remove`
+    sections (the compactor's consumed generations).  Runs AFTER the
+    renames — the superseding shard is already in place, so a crash
+    anywhere in here leaves at worst an extra generation the next
+    compaction pass (or roll-forward of this very record) retires;
+    every step is idempotent."""
+    deletes = doc.get('deletes') or []
+    if not deletes:
+        return
+    from .index_query_mt import shard_cache_invalidate
+    for path in deletes:
+        try:
+            os.unlink(path)
+            shard_cache_invalidate(path)
+        except OSError:
+            pass
+    removals = doc.get('integrity_remove')
+    if isinstance(removals, dict):
+        from . import integrity as mod_integrity
+        for root, rels in removals.items():
+            try:
+                mod_integrity.update_catalog(root, remove=list(rels))
+            except OSError:
+                pass
 
 
 # -- recovery sweep --------------------------------------------------------
@@ -265,6 +323,7 @@ def _roll_forward(indexroot, jpath, doc, result):
                 if isinstance(entries, dict)})
         except OSError:
             pass
+    apply_commit_deletes(doc)
     counter_bump('index recovery rollforwards')
     result['rollforwards'] += 1
     try:
@@ -331,13 +390,23 @@ def sweep_index_tree(indexroot):
         _roll_forward(indexroot, jpath, doc, result)
 
     rolled_back = False
-    for sub in ('', 'by_day', 'by_hour', FOLLOW_DIR):
+    for sub in ('', 'by_day', 'by_hour', FOLLOW_DIR) + ROLLUP_SUBDIRS:
         d = os.path.join(indexroot, sub) if sub else indexroot
         try:
             entries = sorted(os.listdir(d))
         except OSError:
             continue
         for name in entries:
+            if name.startswith(ROLLUP_MANIFEST + '.'):
+                # a manifest update cut short mid-write (same shape as
+                # the catalog-tmp case above): committed manifests
+                # rename atomically, a dead writer's tmp is litter
+                parts = name.split('.')
+                pid = int(parts[-2]) if len(parts) >= 2 and \
+                    parts[-2].isdigit() else None
+                if pid is None or not _pid_alive(pid):
+                    _quarantine(indexroot, os.path.join(d, name))
+                continue
             if _TMP_RE.match(name) is None:
                 continue
             path = os.path.join(d, name)

@@ -94,6 +94,75 @@ def iq_threads():
     return max(1, min(6, (os.cpu_count() or 2) - 1))
 
 
+# -- pool auto-degrade ----------------------------------------------------
+
+# EMA of the warm per-shard query cost (ms), fed by every cached shard
+# query.  Round-5 bench: at 0.654 ms/shard the pool's queue handoffs
+# and GIL convoy made the threaded fan-out SLOWER than the sequential
+# walk (index_query_p50_ms 238.7 vs 218.6 over 365 shards), so when
+# the measured cost sits below the dispatch-amortization threshold the
+# fan-out degrades to the sequential cached loop — byte-identical
+# output either way.
+_SEQ_EMA = [None]
+_SEQ_EMA_LOCK = threading.Lock()
+
+
+def _note_shard_ms(ms):
+    with _SEQ_EMA_LOCK:
+        prev = _SEQ_EMA[0]
+        _SEQ_EMA[0] = ms if prev is None else prev * 0.8 + ms * 0.2
+
+
+def seq_ema_ms():
+    """The measured warm per-shard cost estimate (None until a shard
+    has been queried); `dn serve` /stats surfaces it."""
+    with _SEQ_EMA_LOCK:
+        return _SEQ_EMA[0]
+
+
+def _seq_ema_set(v):
+    """Test hook: pin the measured per-shard cost."""
+    with _SEQ_EMA_LOCK:
+        _SEQ_EMA[0] = v
+
+
+def _iq_auto():
+    """True when the pool size came from 'auto' — an explicit
+    DN_IQ_THREADS / DN_QUERY_CONCURRENCY is an operator override the
+    degrade heuristic must respect."""
+    v = os.environ.get('DN_IQ_THREADS')
+    if v is None:
+        return os.environ.get('DN_QUERY_CONCURRENCY') is None
+    return v == 'auto'
+
+
+def degrade_to_sequential(npaths, nworkers):
+    """Whether this fan-out should skip the pool: per-shard cost below
+    DN_IQ_SEQ_MS (default 2.0 ms; 'off' disables the heuristic), or
+    fewer than DN_IQ_MIN_PER_WORKER (default 4) shards per worker —
+    either way pool dispatch costs more than it overlaps.  Applies
+    only in auto mode."""
+    if not _iq_auto():
+        return False
+    v = os.environ.get('DN_IQ_SEQ_MS', '2.0')
+    if v == 'off':
+        return False
+    try:
+        threshold = float(v)
+    except ValueError:
+        threshold = 2.0
+    try:
+        min_per = max(1, int(os.environ.get('DN_IQ_MIN_PER_WORKER',
+                                            '4')))
+    except ValueError:
+        min_per = 4
+    if npaths < nworkers * min_per:
+        return True
+    with _SEQ_EMA_LOCK:
+        ema = _SEQ_EMA[0]
+    return ema is not None and ema < threshold
+
+
 # -- shard filename time ranges ------------------------------------------
 
 def shard_time_range(path, timeformat):
@@ -134,7 +203,13 @@ def _range_from_entries(path, entries):
             return None
         vals[entry['kind']] = int(digits)
         i += width
-    if i != len(name) or 'Y' not in vals:
+    if i != len(name):
+        # a compactor-pending follow generation ("<base>-gNNNNNN",
+        # index_journal.GEN_SEP) covers exactly its base shard's window
+        rest = name[i:]
+        if not (rest.startswith('-g') and rest[2:].isdigit()):
+            return None
+    if 'Y' not in vals:
         return None
     try:
         start = datetime(vals['Y'], vals.get('m', 1), vals.get('d', 1),
@@ -434,6 +509,8 @@ def shard_cache_clear():
         _EPOCH[0] += 1     # leased handles must not re-enter
         _CACHE_STATS['hits'] = 0
         _CACHE_STATS['misses'] = 0
+    with _SEQ_EMA_LOCK:
+        _SEQ_EMA[0] = None
     with _FIND_LOCK:
         _FIND_CACHE.clear()
     for handle in handles:
@@ -482,6 +559,16 @@ def find_cache_stats():
     """Size of the whole-tree find memo (`dn serve` /stats)."""
     with _FIND_LOCK:
         return {'size': len(_FIND_CACHE)}
+
+
+def cache_epoch():
+    """Monotonic epoch of the shard/find caches — bumped by
+    shard_cache_clear and every whole-tree invalidation
+    (invalidate_index_tree), i.e. whenever an index under this process
+    was rewritten.  The serve result cache stamps entries with it, so
+    an epoch bump retires every cached result at once."""
+    with _CACHE_LOCK:
+        return _EPOCH[0]
 
 
 # -- shard-list (find) cache ----------------------------------------------
@@ -587,8 +674,9 @@ def _query_shard_cached(path, query):
     except DNError as e:
         raise DNError('index "%s" query' % path, cause=e)
     finally:
-        obs_metrics.observe('shard_read_ms',
-                            (perf_counter() - t0) * 1000.0)
+        ms = (perf_counter() - t0) * 1000.0
+        obs_metrics.observe('shard_read_ms', ms)
+        _note_shard_ms(ms)
         checkin_shard(handle, ok=ok)
 
 
@@ -783,6 +871,11 @@ def run_shard_queries(paths, query, nworkers, on_items):
         return                    # empty window: nothing to query
     elif len(paths) == 1:
         on_items(_query_shard_cached(paths[0], query))
+    elif degrade_to_sequential(len(paths),
+                               min(nworkers, len(paths))):
+        counter_bump('index query pool degraded')
+        for path in paths:
+            on_items(_query_shard_cached(path, query))
     else:
         ex = ShardQueryExecutor(query, min(nworkers, len(paths)))
         ex.run(paths, on_items)

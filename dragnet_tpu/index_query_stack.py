@@ -155,6 +155,17 @@ def _sqlite_sort_key(v):
     return (1, v)
 
 
+def canonical_item_sort(items):
+    """Sort (key_tuple, value) items into the per-shard emission order
+    both engines produce for a GROUP BY (SQLite's ORDER BY collation;
+    groupby_native matches it) — the rollup planner's merge of a
+    base+generations group replays through this so its item stream is
+    byte-identical to querying the compacted shard."""
+    return sorted(items,
+                  key=lambda kv: tuple(_sqlite_sort_key(v)
+                                       for v in kv[0]))
+
+
 def _coerce_bucket(v, bz):
     """One decoded value through the shared bucketized-field coercion
     (aggr.coerce_bucket_value — the same rule the per-record and

@@ -121,11 +121,15 @@ def catalog_path(indexroot):
 
 def indexroot_of(shard_path):
     """The index root a shard path belongs to: interval shards live
-    one level down (`by_day/`, `by_hour/`), the `all` shard directly
-    in the root — the only two layouts index_find_params produces."""
+    one level down (`by_day/`, `by_hour/`), rollup shards two levels
+    down (`rollup/by_day/`, `rollup/by_month/`), the `all` shard
+    directly in the root."""
     d = os.path.dirname(os.path.abspath(shard_path))
-    if os.path.basename(d) in ('by_day', 'by_hour'):
-        return os.path.dirname(d)
+    if os.path.basename(d) in ('by_day', 'by_hour', 'by_month'):
+        d = os.path.dirname(d)
+        if os.path.basename(d) == 'rollup':
+            return os.path.dirname(d)
+        return d
     return d
 
 

@@ -39,6 +39,7 @@ def stats_section(registry=None, counters=None):
         registry = mod_metrics.global_registry()
     if counters is not None:
         mod_metrics.refresh_device_gauges(counters, registry)
+        mod_metrics.refresh_rollup_gauges(counters, registry)
     doc = {'version': STATS_METRICS_VERSION,
            'counters': {}, 'gauges': {}, 'histograms': {}}
     for name, labels, m in registry.snapshot():
@@ -121,6 +122,7 @@ def prometheus_text(registry=None, counters=None):
         registry = mod_metrics.global_registry()
     if counters is not None:
         mod_metrics.refresh_device_gauges(counters, registry)
+        mod_metrics.refresh_rollup_gauges(counters, registry)
     lines = []
     typed = set()
     for name, labels, m in registry.snapshot():

@@ -358,3 +358,23 @@ def refresh_device_gauges(counters, registry=None):
         peak = 0.0
     mfu = 100.0 * rate / peak if (rate > 0 and peak > 0) else 0.0
     reg.set_gauge('device_mfu_pct', mfu)
+
+
+def refresh_rollup_gauges(counters, registry=None):
+    """Rollup-planner engagement from the hidden query counters:
+
+    * ``rollup_covered_shards_total`` / ``rollup_shards_read_total``
+      — fine shards whose answers came from rollups, and the coarse
+      shards actually read for them.
+    * ``rollup_coverage_pct`` — share of all fine-shard reads the
+      planner served from rollups (0 when nothing ran; honest zero,
+      like the device gauges).
+    """
+    reg = registry if registry is not None else _GLOBAL
+    covered = int(counters.get('index shards via rollup', 0) or 0)
+    read = int(counters.get('rollup shards queried', 0) or 0)
+    queried = int(counters.get('index shards queried', 0) or 0)
+    reg.set_gauge('rollup_covered_shards_total', covered)
+    reg.set_gauge('rollup_shards_read_total', read)
+    reg.set_gauge('rollup_coverage_pct',
+                  100.0 * covered / queried if queried else 0.0)

@@ -121,6 +121,22 @@ def backend_probed():
     return _backend_ready
 
 
+def backend_reset():
+    """Drop the memoized backend verdict and ask jax to discard its
+    live backends, so the next backend_ready() re-initializes from
+    scratch — the in-process half of probe-failure recovery (the
+    other half is a fresh-process re-exec; bench.py uses both).
+    Best-effort: a backend wedged inside a device call stays wedged
+    until the process exits."""
+    global _backend_ready
+    _backend_ready = None
+    try:
+        import jax
+        jax.clear_backends()
+    except Exception:
+        pass
+
+
 def platform_hint():
     """Cheap, non-backend-initializing guess at the jax platform: the
     first entry of JAX_PLATFORMS ('' when unset, meaning jax would

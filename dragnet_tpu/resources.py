@@ -311,6 +311,7 @@ class ResourceGovernor(object):
         self._mem_inflight = 0
         self._mem_reservations = 0
         self._mem_sheds = 0
+        self._cache_bytes = 0
         # background poll thread (serve mode); on-demand callers just
         # ride the throttled refresh
         self._stop = threading.Event()
@@ -506,6 +507,31 @@ class ResourceGovernor(object):
             self._mem_used = max(0, self._mem_used - lease._nbytes)
             self._mem_inflight = max(0, self._mem_inflight - 1)
 
+    def reserve_cache(self, nbytes):
+        """Charge result-cache residency against the same budget the
+        request admission draws on, so cached bytes and in-flight
+        request bytes share one accounting.  Returns False (without
+        reserving) when the bytes would push the budget over — the
+        cache then evicts or skips the fill.  With no memory budget
+        configured the reservation always succeeds and is merely
+        tracked."""
+        if nbytes <= 0:
+            return True
+        budget = self.budget_bytes()
+        with self._lock:
+            if budget > 0 and self._mem_used + nbytes > budget:
+                return False
+            self._mem_used += nbytes
+            self._cache_bytes += nbytes
+        return True
+
+    def release_cache(self, nbytes):
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self._mem_used = max(0, self._mem_used - nbytes)
+            self._cache_bytes = max(0, self._cache_bytes - nbytes)
+
     # -- reporting ---------------------------------------------------------
 
     def stats_doc(self):
@@ -530,6 +556,7 @@ class ResourceGovernor(object):
                 'memory': {
                     'budget_bytes': self.budget_bytes(),
                     'used_bytes': self._mem_used,
+                    'cache_bytes': self._cache_bytes,
                     'inflight': self._mem_inflight,
                     'reservations': self._mem_reservations,
                     'sheds': self._mem_sheds},

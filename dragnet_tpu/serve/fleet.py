@@ -84,6 +84,17 @@ def _member_row(name, st, latency=None):
         'history': bool(hist.get('enabled')),
         'events': bool((st.get('events') or {}).get('enabled')),
     }
+    # repeat-traffic economics: result-cache hit rate, rollup
+    # coverage, and the compaction backlog per member (PR 16)
+    rcache = ((st.get('caches') or {}).get('results')) or {}
+    if rcache.get('enabled'):
+        row['cache_hit_rate'] = rcache.get('hit_rate')
+    roll = st.get('rollup') or {}
+    if roll:
+        row['rollup_coverage'] = roll.get('coverage_ratio')
+    maint = st.get('maintenance')
+    if maint is not None:
+        row['compact_backlog'] = maint.get('compact_backlog')
     res = st.get('resources') or {}
     if res:
         # resource governance: the member's disk mode and headroom
@@ -251,6 +262,10 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
               'queued': 0}
     handoff = {}
     follow = {}
+    cache_hits = cache_misses = 0
+    cache_on = False
+    roll_covered = roll_queried = 0
+    compact_backlog = None
     for name in names:
         st = stats.get(name)
         if st is None:
@@ -289,6 +304,18 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
         rp = ((st.get('integrity') or {}).get('repair')) or {}
         for k in repair:
             repair[k] += rp.get(k, 0)
+        rc = ((st.get('caches') or {}).get('results')) or {}
+        if rc.get('enabled'):
+            cache_on = True
+            cache_hits += rc.get('hits', 0) or 0
+            cache_misses += rc.get('misses', 0) or 0
+        roll = st.get('rollup') or {}
+        roll_covered += roll.get('covered_shards', 0) or 0
+        roll_queried += roll.get('shards_queried', 0) or 0
+        maint = st.get('maintenance')
+        if maint is not None:
+            compact_backlog = (compact_backlog or 0) + \
+                (maint.get('compact_backlog') or 0)
         fl = st.get('follow')
         if fl is not None:
             follow[name] = {'ingest_lag_ms': fl.get('ingest_lag_ms'),
@@ -338,6 +365,17 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
         'qps_1m': round(qps, 3) if qps is not None else None,
         'shed_rate_1m': round(shed_rate, 3)
         if shed_rate is not None else None,
+        # fleet repeat-traffic economics: hit rate over SUMMED member
+        # hits/misses (never averaged rates), rollup coverage over
+        # summed shard counts, total compaction backlog (None when no
+        # member runs a cache / maintenance timer — honest absence)
+        'cache_hit_rate': round(
+            cache_hits / (cache_hits + cache_misses), 4)
+        if cache_on and (cache_hits + cache_misses) else
+        (0.0 if cache_on else None),
+        'rollup_coverage': round(roll_covered / roll_queried, 4)
+        if roll_queried else 0.0,
+        'compact_backlog': compact_backlog,
     }
     if agg_latency is not None and agg_latency.total:
         aggregate['latency'] = {
@@ -411,6 +449,12 @@ def fleet_prometheus_text(doc):
     reg.inc('fleet_shed_total', agg['shed'])
     if agg.get('qps_1m') is not None:
         reg.set_gauge('fleet_qps_1m', agg['qps_1m'])
+    if agg.get('cache_hit_rate') is not None:
+        reg.set_gauge('fleet_cache_hit_rate', agg['cache_hit_rate'])
+    if agg.get('rollup_coverage') is not None:
+        reg.set_gauge('fleet_rollup_coverage', agg['rollup_coverage'])
+    if agg.get('compact_backlog') is not None:
+        reg.set_gauge('fleet_compact_backlog', agg['compact_backlog'])
     lat = agg.get('latency')
     if lat:
         reg.set_gauge('fleet_latency_p50_ms', lat['p50'])

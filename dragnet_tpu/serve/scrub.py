@@ -454,3 +454,149 @@ class ScrubThread(object):
                     self.last_error = repr(e)
                 if self.log is not None:
                     self.log.error('scrub pass failed', err=repr(e))
+
+
+class MaintenanceThread(object):
+    """The rollup/compaction timer `dn serve` runs under
+    DN_ROLLUP_INTERVAL_S / DN_COMPACT_INTERVAL_S > 0 — the scrub
+    thread's sibling on the same member-datasource walk and the same
+    governor discipline (background disk consumers pause under
+    pressure and resume on their own).
+
+    * Rollup refresh (rollup.build_rollups) runs WITHOUT the tree
+      write lock: a build only ADDS shards and atomically republishes
+      the manifest — concurrent queries either still plan fine shards
+      or pick up the finished rollup, never a torn view.
+
+    * Compaction holds the tree write lock per GROUP (one base shard
+      + its generations — the same short exclusive window a build
+      takes), so a query can never enumerate a generation the commit
+      record is about to delete.  Every completed group bumps the
+      writer-invalidation epoch through _notify_index_written, which
+      retires result-cache entries and reader memos.
+    """
+
+    INTERVALS = ('hour', 'day')
+
+    def __init__(self, server, rollup_s, compact_s, min_gens,
+                 log=None):
+        self.server = server
+        self.rollup_s = rollup_s
+        self.compact_s = compact_s
+        self.min_gens = min_gens
+        self.log = log
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.runs = 0
+        self.last = None
+        self.last_error = None
+        self.backlog = 0
+        self._thread = threading.Thread(
+            target=self._run, name='dn-maintenance', daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def stats(self):
+        with self._lock:
+            return {'rollup_interval_s': self.rollup_s,
+                    'compact_interval_s': self.compact_s,
+                    'compact_min_gens': self.min_gens,
+                    'runs': self.runs,
+                    'compact_backlog': self.backlog,
+                    'last': self.last,
+                    'last_error': self.last_error}
+
+    def _rollup_pass(self):
+        from .. import rollup as mod_rollup
+        doc = {'built': 0, 'fresh': 0, 'removed': 0, 'paused': False}
+        for dsname, ds in member_datasources(self.server):
+            for interval in self.INTERVALS:
+                r = mod_rollup.build_rollups(
+                    ds.ds_indexpath, interval,
+                    governor=self.server.governor)
+                for k in ('built', 'fresh', 'removed'):
+                    doc[k] += r[k]
+                doc['paused'] = doc['paused'] or r['paused']
+        if doc['built']:
+            obs_metrics.inc('rollup_shards_built_total',
+                            doc['built'])
+        return doc
+
+    def _compact_pass(self):
+        from .. import rollup as mod_rollup
+        doc = {'groups': 0, 'compacted': 0, 'generations_removed': 0,
+               'paused': False}
+        backlog = 0
+        for dsname, ds in member_datasources(self.server):
+            root = ds.ds_indexpath
+            for interval in self.INTERVALS:
+                groups = [
+                    (b, g)
+                    for b, g in mod_rollup.find_gen_groups(root,
+                                                           interval)
+                    if len(g) >= self.min_gens]
+                doc['groups'] += len(groups)
+                for base, gens in groups:
+                    if self.server.governor.mode() != 'ok':
+                        doc['paused'] = True
+                        obs_events.emit_burst(
+                            'resource.paused', key='compact',
+                            component='compact')
+                        break
+                    if self._stop.is_set():
+                        break
+                    # the same short exclusive window a build takes:
+                    # queries drain, the group rewrites, queries
+                    # resume against the compacted shard
+                    with self.server._tree_lock(ds, dsname).write():
+                        mod_rollup.compact_group(root, interval,
+                                                 base, gens)
+                    doc['compacted'] += 1
+                    doc['generations_removed'] += len(gens)
+                backlog += mod_rollup.compaction_backlog(root,
+                                                         interval)
+        if doc['compacted']:
+            obs_metrics.inc('compact_groups_total', doc['compacted'])
+            obs_metrics.inc('compact_generations_removed_total',
+                            doc['generations_removed'])
+        obs_metrics.set_gauge('compact_backlog', backlog)
+        with self._lock:
+            self.backlog = backlog
+        return doc
+
+    def _run(self):
+        import time as mod_time
+        tick = min(s for s in (self.rollup_s, self.compact_s)
+                   if s > 0)
+        next_rollup = mod_time.monotonic() + self.rollup_s \
+            if self.rollup_s > 0 else None
+        next_compact = mod_time.monotonic() + self.compact_s \
+            if self.compact_s > 0 else None
+        while not self._stop.wait(tick):
+            now = mod_time.monotonic()
+            last = {}
+            try:
+                if next_compact is not None and now >= next_compact:
+                    last['compact'] = self._compact_pass()
+                    next_compact = mod_time.monotonic() \
+                        + self.compact_s
+                if next_rollup is not None and now >= next_rollup:
+                    last['rollup'] = self._rollup_pass()
+                    next_rollup = mod_time.monotonic() \
+                        + self.rollup_s
+                if last:
+                    with self._lock:
+                        self.runs += 1
+                        self.last = last
+                        self.last_error = None
+            except Exception as e:
+                with self._lock:
+                    self.last_error = repr(e)
+                if self.log is not None:
+                    self.log.error('maintenance pass failed',
+                                   err=repr(e))

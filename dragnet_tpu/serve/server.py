@@ -78,6 +78,7 @@ from . import admission as mod_admission
 from . import ioloop as mod_ioloop
 from . import lifecycle as mod_lifecycle
 from . import protocol as mod_protocol
+from . import qcache as mod_qcache
 
 MAX_REQUEST_BYTES = mod_protocol.MAX_FRAME_BYTES
 
@@ -316,12 +317,20 @@ class DnServer(object):
         from . import scrub as mod_scrub
         self.repair = mod_scrub.RepairManager(self)
         self.scrubber = None
+        self.maintainer = None
         self.admission = mod_admission.Admission(
             conf['max_inflight'], conf['queue_depth'],
             tenant_quota=conf['tenant_quota'],
             tenant_weights=conf['tenant_weights'],
             tenant_default_weight=conf['tenant_default_weight'])
         self.coalescer = mod_admission.Coalescer(conf['coalesce'])
+        # query-result cache (serve/qcache.py): repeat identical
+        # queries answer from memory — no lease, no admission slot —
+        # invalidated by the writer-invalidation epoch + tree stat
+        # validators, residency charged against the governor's shared
+        # memory budget.  DN_SERVE_CACHE_MB=0 (default) disables.
+        self.qcache = mod_qcache.ResultCache(
+            conf['cache_mb'] << 20, governor=self.governor)
         # fleet observability (obs/history.py, obs/events.py,
         # serve/fleet.py): the metric-history snapshotter and the
         # event journal are armed at bind from DN_METRICS_HISTORY_S /
@@ -414,6 +423,18 @@ class DnServer(object):
                 self, self.integrity_conf['scrub_interval_s'],
                 self.integrity_conf['scrub_rate_mb_s'] << 20,
                 log=self.log).start()
+        if self.integrity_conf['rollup_interval_s'] > 0 or \
+                self.integrity_conf['compact_interval_s'] > 0:
+            # the rollup/compaction timer (serve/scrub.py): refresh
+            # day/month rollup shards and fold follow --append
+            # mini-generations in the background, governor-paused
+            # under disk pressure
+            from . import scrub as mod_scrub
+            self.maintainer = mod_scrub.MaintenanceThread(
+                self, self.integrity_conf['rollup_interval_s'],
+                self.integrity_conf['compact_interval_s'],
+                self.integrity_conf['compact_min_gens'],
+                log=self.log).start()
         # the event journal is per-PROCESS (emit sites are global,
         # like DN_TRACE): the first server to bind installs it;
         # embedded co-process members share it (the fleet merge
@@ -491,6 +512,8 @@ class DnServer(object):
             self.history.stop()
         if self.scrubber is not None:
             self.scrubber.stop()
+        if self.maintainer is not None:
+            self.maintainer.stop()
         self.governor.stop()
         self.repair.stop()
         if self.puller is not None:
@@ -498,7 +521,9 @@ class DnServer(object):
         if self.router is not None:
             self.router.stop()
         # flush warm state cleanly: cached shard handles hold open
-        # mmaps / sqlite connections
+        # mmaps / sqlite connections; the result cache hands its
+        # reserved governor bytes back
+        self.qcache.clear()
         mod_iqmt.shard_cache_clear()
         if self._hook is not None:
             mod_lifecycle.remove_writer_invalidation(self._hook)
@@ -834,6 +859,7 @@ class DnServer(object):
             'caches': {
                 'shard_handles': mod_iqmt.shard_cache_stats(),
                 'find_memo': mod_iqmt.find_cache_stats(),
+                'results': self.qcache.stats(),
             },
             'counters': counters,
             'device': {
@@ -873,6 +899,26 @@ class DnServer(object):
                 'scrub': self.scrubber.stats()
                 if self.scrubber is not None else None,
             },
+            # rollup-planner engagement (rollup.py via the hidden
+            # query counters): fine shards answered from rollups vs
+            # every fine-shard read, as a coverage ratio
+            'rollup': {
+                'covered_shards':
+                counters.get('index shards via rollup', 0),
+                'rollup_shards_read':
+                counters.get('rollup shards queried', 0),
+                'shards_queried':
+                counters.get('index shards queried', 0),
+                'coverage_ratio': round(
+                    counters.get('index shards via rollup', 0) /
+                    counters.get('index shards queried', 1), 4)
+                if counters.get('index shards queried', 0) else 0.0,
+            },
+            # rollup/compaction timer summary (serve/scrub.py
+            # MaintenanceThread): pass counters, compaction backlog;
+            # None when both intervals are 0
+            'maintenance': self.maintainer.stats()
+            if self.maintainer is not None else None,
             # the typed registry (obs/metrics.py): versioned so
             # dashboards can gate on shape; histograms carry
             # p50/p90/p99 and cumulative buckets
@@ -1458,6 +1504,8 @@ class DnServer(object):
             'elapsed_ms': round((time.monotonic() - t0) * 1000, 3),
             'counters': scope_out,
         }
+        if flags.get('cached'):
+            extra['cached'] = True
         if flags['busy'] or flags['overloaded'] or \
                 flags['draining'] or flags.get('retryable_error'):
             # the request was never admitted (or failed degraded /
@@ -1577,6 +1625,29 @@ class DnServer(object):
         key = mod_admission.compute_key(
             req, _config_ident(backend.cbl_path))
 
+        # result cache (serve/qcache.py): a valid hit skips the
+        # lease, the admission slot, and the tree read entirely.
+        # The epoch and validators are captured BEFORE the compute:
+        # a write racing the execution stamps the entry already-stale
+        # (a wasted put), never a stale hit.
+        use_cache = op == 'query' and not opts.dry_run and \
+            key is not None and self.qcache.enabled()
+        cache_epoch = mod_iqmt.cache_epoch() if use_cache else 0
+        if use_cache:
+            cached = self.qcache.get(key, cache_epoch)
+            if cached is not None:
+                # no exec_t0: like a coalesced follower, a hit never
+                # held a slot, so it must not feed the service-time
+                # estimate the shed/retry hints key off
+                flags['cached'] = True
+                obs_metrics.inc('serve_result_cache_hits_total')
+                mod_cli.dn_output(query, opts,
+                                  cached.clone_for_output(), dsname)
+                return 0
+            obs_metrics.inc('serve_result_cache_misses_total')
+        cache_validators = mod_qcache.tree_validators(
+            getattr(ds, 'ds_indexpath', None)) if use_cache else None
+
         def compute():
             lease = self._admit_resources(op, ds)
             try:
@@ -1621,6 +1692,9 @@ class DnServer(object):
                 raise
             mod_cli.fatal(e)
         flags['coalesced'] = shared
+        if use_cache:
+            self.qcache.put(key, cache_epoch, cache_validators,
+                            result)
         # coalesced requests demux through private clones: the output
         # layer mutates the pipeline it formats
         mod_cli.dn_output(query, opts, result.clone_for_output(),

@@ -87,6 +87,16 @@ def render_frame(doc, ansi=True):
         lines.append('repair queued %d completed %d failed %d'
                      % (rp.get('queued', 0), rp.get('completed', 0),
                         rp.get('failed', 0)))
+    # repeat-traffic line: only when some member runs a cache or a
+    # maintenance timer (bare fleets keep the old frame byte-for-byte)
+    if agg.get('cache_hit_rate') is not None or \
+            agg.get('compact_backlog') is not None or \
+            agg.get('rollup_coverage'):
+        lines.append(
+            'cache hit %s  rollup cov %s  compact backlog %s'
+            % (_fmt(agg.get('cache_hit_rate')),
+               _fmt(agg.get('rollup_coverage')),
+               _fmt(agg.get('compact_backlog'))))
     if doc.get('members_read_only'):
         lines.append('%sDISK: %d member(s) read-only (min free %s%%)'
                      '%s'
@@ -99,8 +109,8 @@ def render_frame(doc, ansi=True):
     lines.append('')
 
     cols = ('member', 'state', 'epoch', 'qps', 'p50', 'p95',
-            'inflight', 'shed', 'repair', 'lag')
-    widths = [11, 9, 7, 8, 9, 9, 10, 7, 7, 9]
+            'inflight', 'shed', 'repair', 'lag', 'cache', 'backlog')
+    widths = [11, 9, 7, 8, 9, 9, 10, 7, 7, 9, 7, 8]
     lines.append(d + ''.join(c.ljust(w)
                              for c, w in zip(cols, widths)) + r)
     breakers = doc.get('breakers') or {}
@@ -122,7 +132,9 @@ def render_frame(doc, ansi=True):
                        row.get('queued', '-'))
             if row.get('ok') else '-',
             _fmt(row.get('shed')), _fmt(row.get('repair_queued')),
-            _fmt(row.get('ingest_lag_ms'), 'ms'))
+            _fmt(row.get('ingest_lag_ms'), 'ms'),
+            _fmt(row.get('cache_hit_rate')),
+            _fmt(row.get('compact_backlog')))
         line = ''.join(str(v).ljust(w)
                        for v, w in zip(vals, widths))
         lines.append(line)

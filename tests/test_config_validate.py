@@ -145,7 +145,8 @@ def test_serve_config_defaults():
                     'read_deadline_ms': 10000,
                     'write_deadline_ms': 60000, 'idle_ms': 300000,
                     'tenant_quota': 0, 'tenant_default_weight': 1,
-                    'fleet_timeout_s': 5, 'tenant_weights': {}}
+                    'fleet_timeout_s': 5, 'cache_mb': 0,
+                    'tenant_weights': {}}
 
 
 def test_serve_config_parses_overrides():
@@ -156,13 +157,14 @@ def test_serve_config_parses_overrides():
         'DN_SERVE_WRITE_DEADLINE_MS': '0', 'DN_SERVE_IDLE_MS': '900',
         'DN_SERVE_TENANT_QUOTA': '3',
         'DN_SERVE_TENANT_DEFAULT_WEIGHT': '2',
-        'DN_SERVE_TENANT_WEIGHTS': 'alice:3, bob:1'})
+        'DN_SERVE_TENANT_WEIGHTS': 'alice:3, bob:1',
+        'DN_SERVE_CACHE_MB': '64'})
     assert conf == {'max_inflight': 2, 'queue_depth': 0,
                     'deadline_ms': 1500, 'coalesce': False,
                     'drain_s': 5, 'read_deadline_ms': 250,
                     'write_deadline_ms': 0, 'idle_ms': 900,
                     'tenant_quota': 3, 'tenant_default_weight': 2,
-                    'fleet_timeout_s': 5,
+                    'fleet_timeout_s': 5, 'cache_mb': 64,
                     'tenant_weights': {'alice': 3, 'bob': 1}}
 
 
@@ -366,16 +368,23 @@ def test_topo_config_rejects_bad_values():
 def test_integrity_config_defaults():
     conf = mod_config.integrity_config(env={})
     assert conf == {'verify': 'off', 'scrub_interval_s': 0,
-                    'scrub_rate_mb_s': 64, 'quarantine_max_mb': 0}
+                    'scrub_rate_mb_s': 64, 'quarantine_max_mb': 0,
+                    'rollup_interval_s': 0, 'compact_interval_s': 0,
+                    'compact_min_gens': 4}
 
 
 def test_integrity_config_parses_overrides():
     conf = mod_config.integrity_config(env={
         'DN_VERIFY': 'full',
         'DN_SCRUB_INTERVAL_S': '300',
-        'DN_SCRUB_RATE_MB_S': '0'})
+        'DN_SCRUB_RATE_MB_S': '0',
+        'DN_ROLLUP_INTERVAL_S': '60',
+        'DN_COMPACT_INTERVAL_S': '30',
+        'DN_COMPACT_MIN_GENS': '2'})
     assert conf == {'verify': 'full', 'scrub_interval_s': 300,
-                    'scrub_rate_mb_s': 0, 'quarantine_max_mb': 0}
+                    'scrub_rate_mb_s': 0, 'quarantine_max_mb': 0,
+                    'rollup_interval_s': 60, 'compact_interval_s': 30,
+                    'compact_min_gens': 2}
 
 
 def test_integrity_config_rejects_bad_values():
@@ -397,14 +406,15 @@ def test_faults_config_accepts_flip_kind():
 def test_follow_config_defaults():
     conf = mod_config.follow_config(env={})
     assert conf == {'latency_ms': 500, 'max_bytes': 4 << 20,
-                    'poll_ms': 50}
+                    'poll_ms': 50, 'append': False}
 
 
 def test_follow_config_parses_overrides():
     conf = mod_config.follow_config(env={
         'DN_FOLLOW_LATENCY_MS': '0', 'DN_FOLLOW_MAX_BYTES': '1024',
-        'DN_FOLLOW_POLL_MS': '5'})
-    assert conf == {'latency_ms': 0, 'max_bytes': 1024, 'poll_ms': 5}
+        'DN_FOLLOW_POLL_MS': '5', 'DN_FOLLOW_APPEND': '1'})
+    assert conf == {'latency_ms': 0, 'max_bytes': 1024, 'poll_ms': 5,
+                    'append': True}
 
 
 def test_follow_config_rejects_bad_values():
@@ -412,7 +422,8 @@ def test_follow_config_rejects_bad_values():
                 {'DN_FOLLOW_LATENCY_MS': '-1'},
                 {'DN_FOLLOW_MAX_BYTES': '0'},
                 {'DN_FOLLOW_MAX_BYTES': '12.5'},
-                {'DN_FOLLOW_POLL_MS': '0'}):
+                {'DN_FOLLOW_POLL_MS': '0'},
+                {'DN_FOLLOW_APPEND': 'yes'}):
         err = mod_config.follow_config(env=env)
         assert isinstance(err, DNError), env
         assert str(err).startswith(list(env)[0]), env

@@ -37,6 +37,20 @@ mid-batch (externally and via kill-kind faults at the publish seams),
 restarted, and caught up — after EVERY kill the index tree must
 byte-equal a from-scratch `dn build` over the exact checkpointed
 input prefix (zero duplicated, zero lost points), with no litter.
+
+`--compact` runs the background-compaction drill instead (`make
+soak-compact`): `dn follow --once` rounds in append mode
+(DN_FOLLOW_APPEND) land every batch as mini-generations while a
+`dn serve` member — result cache on, 1-second maintenance timer —
+compacts generation groups and refreshes rollup shards under armed
+compact.publish/rollup.publish faults and a remote query flood;
+separate `dn compact` / `dn rollup` subprocesses are SIGKILLed
+mid-publish on both sides of the commit record.  Every accepted
+response must byte-equal a from-scratch `dn build` (generations
+pending, mid-rewrite, post-kill, post-compaction), failures must be
+clean `dn:` errors, and after a final converge compaction the live
+tree must byte-equal the from-scratch build shard for shard with
+zero stranded tmps.
 """
 
 import argparse
@@ -2203,6 +2217,502 @@ def soak_follow(root, fast=False, verbose=True, floor=None):
     return summary
 
 
+# -- background-compaction (append + compact + rollup) drill ----------------
+
+# error-kind chaos armed while the serve-resident maintenance timer
+# rewrites the tree under flood: each firing aborts one group/shard
+# publish cleanly (prepared tmps discarded via sink.abort) and the
+# next tick retries until the pass lands
+COMPACT_ERR_SPEC = ('compact.publish:error:0.35:91,'
+                    'rollup.publish:error:0.35:92')
+# subprocess kill placement: compact.publish lands the SIGKILL after
+# the compacted shard is prepared but before the commit record
+# (rollback side), sink.rename after the commit record (roll-forward
+# side), rollup.publish mid-rollup-build — a recovered tree must keep
+# answering byte-identically in every case (compaction and rollups
+# never change query bytes)
+COMPACT_KILL_SPECS = ('compact.publish:kill:1.0',
+                      'sink.rename:kill:1.0',
+                      'rollup.publish:kill:1.0')
+
+
+class CompactSoak(object):
+    """One format's append/compact/rollup drill (`--compact`): `dn
+    follow --once` rounds in append mode land every batch as
+    mini-generations while a `dn serve` member (result cache on, a
+    1-second maintenance timer) compacts generation groups under the
+    tree write lock and refreshes rollup shards, with the publish
+    seams armed and a remote query flood running; separate subprocess
+    `dn compact` / `dn rollup` runs are SIGKILLed mid-publish.  The
+    contract: every accepted response is byte-identical to a
+    from-scratch `dn build` over the same input — with generations
+    pending, mid-rewrite, after every kill, after compaction —
+    failures are clean `dn:` errors, zero stranded tmps, and the
+    final compacted tree byte-equals the from-scratch build shard
+    for shard."""
+
+    def __init__(self, root, fmt, verbose=True):
+        self.root = root
+        self.fmt = fmt
+        self.verbose = verbose
+        self.violations = []
+        self.ops = 0
+        self.kills = 0
+        self.clean_errors = 0
+        self.n = 0
+        self.golden = []
+        self.datafile = os.path.join(root, 'compact_data_%s.log' % fmt)
+        self.prefix = os.path.join(root, 'compact_prefix_%s.log' % fmt)
+        self.idx = os.path.join(root, 'idx_compact_%s' % fmt)
+        self.ref_idx = os.path.join(root, 'idx_cref_%s' % fmt)
+        self.ds = 'dscomp_' + fmt
+        self.ref_ds = 'dscref_' + fmt
+        self._flood_threads = []
+        open(self.datafile, 'w').close()
+        for ds, path, idx in ((self.ds, self.datafile, self.idx),
+                              (self.ref_ds, self.prefix,
+                               self.ref_idx)):
+            rc, out, err = run_cli([
+                'datasource-add', '--path', path, '--index-path',
+                idx, '--time-field', 'time', ds])
+            assert rc == 0, err
+            rc, out, err = run_cli([
+                'metric-add', '-b',
+                'timestamp[date,field=time,aggr=lquantize,'
+                'step=86400],host,latency[aggr=quantize]', ds, 'm1'])
+            assert rc == 0, err
+            rc, out, err = run_cli([
+                'metric-add', '-b', 'operation', '-f',
+                '{"eq": ["operation", "get"]}', ds, 'm2'])
+            assert rc == 0, err
+
+    def note(self, msg):
+        if self.verbose:
+            sys.stderr.write('soak: [%s] %s\n' % (self.fmt, msg))
+
+    def violate(self, msg):
+        self.violations.append('[%s] %s' % (self.fmt, msg))
+        sys.stderr.write('soak: VIOLATION: [%s] %s\n'
+                         % (self.fmt, msg))
+
+    def _env_block(self):
+        """Installed once for the whole drill (run_cli's per-call env
+        install mutates the process environment, so the flood threads
+        must never depend on a per-call env)."""
+        return {'DN_INDEX_FORMAT': self.fmt,
+                'DN_IQ_STAT_TTL_MS': '0',
+                'DN_FOLLOW_LATENCY_MS': '0',
+                'DN_FOLLOW_MAX_BYTES': '65536',
+                'DN_FOLLOW_POLL_MS': '5',
+                'DN_FOLLOW_APPEND': '1',
+                'DN_REMOTE_RETRIES': '3',
+                'DN_REMOTE_BACKOFF_MS': '5',
+                'DN_SERVE_CLIENT_TIMEOUT_S': '60',
+                # the serve member's maintenance timer + result cache
+                # knobs (read at server construction)
+                'DN_ROLLUP_INTERVAL_S': '1',
+                'DN_COMPACT_INTERVAL_S': '1',
+                'DN_COMPACT_MIN_GENS': '1'}
+
+    def case_args(self):
+        return [
+            ['-b', 'host'],
+            ['-b', 'host,latency[aggr=quantize]', '--raw'],
+            ['--points', '-b', 'operation', '-f',
+             '{"eq": ["operation", "get"]}'],
+            ['-b', 'host', '-A', '2014-01-02', '-B', '2014-01-04'],
+        ]
+
+    def append_round(self, n):
+        """Append `n` records and land them: the first round creates
+        the base shards, every later round's batch publishes as one
+        mini-generation per touched base (DN_FOLLOW_APPEND)."""
+        gen_data(self.datafile, n, start=self.n, days=5)
+        self.n += n
+        rc, out, err = run_cli(['follow', '--once', self.ds])
+        self.ops += 1
+        if rc != 0:
+            self.violate('follow --once failed: %r' % err[-300:])
+
+    def refresh_ref(self):
+        """Rebuild the from-scratch reference over the full appended
+        input and re-capture the golden bytes for every query case."""
+        import shutil
+        shutil.copyfile(self.datafile, self.prefix)
+        shutil.rmtree(self.ref_idx, ignore_errors=True)
+        mod_journal.reset_sweep_memo()
+        rc, out, err = run_cli(['build', self.ref_ds])
+        self.ops += 1
+        if rc != 0:
+            self.violate('reference build failed: %r' % err[-300:])
+            return
+        self.golden = []
+        for args in self.case_args():
+            ref = run_cli(['query'] + args + [self.ref_ds])
+            self.ops += 1
+            if ref[0] != 0:
+                self.violate('golden query failed: %r' % ref[2][-300:])
+                continue
+            self.golden.append((args, ref[1]))
+
+    def verify(self, when, remote=None):
+        """Byte-identity against the from-scratch reference — local
+        reads when the tree is quiesced, `--remote` through the serve
+        member (whose tree lock serializes against the compactor)
+        while the maintenance timer is live."""
+        for args, gold in self.golden:
+            case = ['query'] + (['--remote', remote]
+                                if remote else []) + args + [self.ds]
+            got = run_cli(case)
+            self.ops += 1
+            if got[0] != 0 or got[1] != gold:
+                self.violate('%s: query %s diverges from the '
+                             'from-scratch build (rc=%d)'
+                             % (when, ' '.join(args), got[0]))
+
+    def check_litter(self, when):
+        mod_journal.reset_sweep_memo()
+        mod_journal.sweep_index_tree(self.idx)
+        bad = [p for p in tree_tmp_litter(self.idx)
+               if mod_journal.FOLLOW_DIR not in p]
+        if bad:
+            self.violate('%s: stranded tmps: %s' % (when, bad))
+
+    # -- the serve phase: flood + armed maintenance rewrites ----------
+
+    def start_flood(self, sock, nthreads=2):
+        self._stop_flood = threading.Event()
+        self._flood_results = []
+        lock = threading.Lock()
+        golden = list(self.golden)
+
+        def worker(tid):
+            i = tid
+            while not self._stop_flood.is_set():
+                args, gold = golden[i % len(golden)]
+                got = run_cli(['query', '--remote', sock] + args +
+                              [self.ds])
+                with lock:
+                    self._flood_results.append((args, gold, got))
+                i += 1
+
+        self._flood_threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(nthreads)]
+        for t in self._flood_threads:
+            t.start()
+
+    def stop_flood(self):
+        self._stop_flood.set()
+        for t in self._flood_threads:
+            t.join(120)
+            if t.is_alive():
+                self.violate('flood: query thread hung')
+        self._flood_threads = []
+        served = errors = 0
+        for args, gold, (rc, out, err) in self._flood_results:
+            self.ops += 1
+            if rc == 0:
+                if out != gold:
+                    self.violate('flood: accepted response with '
+                                 'divergent bytes (%s)'
+                                 % ' '.join(args))
+                else:
+                    served += 1
+                continue
+            text = err.decode('utf-8', 'replace')
+            if 'Traceback' in text or 'dn:' not in text:
+                self.violate('flood: unclean failure: %r'
+                             % text[-300:])
+            else:
+                self.clean_errors += 1
+                errors += 1
+        self.note('flood: %d byte-identical responses, %d clean '
+                  'errors' % (served, errors))
+
+    def wait_drained(self, timeout_s):
+        """Block until the serve member's compactor has folded every
+        pending mini-generation (the soak process runs no compactor
+        of its own here, so a drained backlog PROVES the server-side
+        rewrite happened)."""
+        from dragnet_tpu import rollup as mod_rollup
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if sum(mod_rollup.compaction_backlog(self.idx, iv)
+                   for iv in ('hour', 'day')) == 0:
+                return True
+            time.sleep(0.25)
+        return False
+
+    def serve_phase(self, fast=False):
+        sock = os.path.join(self.root,
+                            'dn-compact-%s.sock' % self.fmt)
+        if os.path.exists(sock):
+            os.unlink(sock)
+        srv = mod_server.DnServer(
+            socket_path=sock,
+            conf={'max_inflight': 4, 'queue_depth': 16,
+                  'deadline_ms': 0, 'coalesce': False, 'drain_s': 10,
+                  'cache_mb': 8}).start()
+        prior = os.environ.get('DN_FAULTS')
+        os.environ['DN_FAULTS'] = COMPACT_ERR_SPEC
+        mod_faults.reset()
+        rounds = 2 if fast else 5
+        try:
+            for r in range(rounds):
+                self.append_round(150)
+                self.refresh_ref()
+                self.verify('round %d generations pending' % r,
+                            remote=sock)
+                self.start_flood(sock, nthreads=2)
+                drained = self.wait_drained(90)
+                time.sleep(0.5)
+                self.stop_flood()
+                if not drained:
+                    self.violate('round %d: compaction backlog never '
+                                 'drained under armed faults' % r)
+                # backlog 0: no compaction can race these local reads
+                self.verify('round %d compacted' % r)
+            doc = mod_client.stats(sock, timeout_s=30.0)
+            self.ops += 1
+            rcache = (doc.get('caches') or {}).get('results') or {}
+            if not rcache.get('enabled') or not rcache.get('hits'):
+                self.violate('serve phase: result cache recorded no '
+                             'hits: %r' % (rcache,))
+            maint = doc.get('maintenance') or {}
+            if not maint.get('runs'):
+                self.violate('serve phase: maintenance timer never '
+                             'ran: %r' % (maint,))
+            counters = doc.get('counters') or {}
+            if not counters.get('follow generations appended'):
+                self.violate('serve phase: no mini-generations were '
+                             'appended')
+            if not counters.get('rollup shards built'):
+                self.violate('serve phase: no rollup shards built')
+        finally:
+            if prior is None:
+                os.environ.pop('DN_FAULTS', None)
+            else:
+                os.environ['DN_FAULTS'] = prior
+            mod_faults.reset()
+            srv.stop()
+        self.check_litter('serve phase')
+
+    # -- the kill phase: subprocess maintenance SIGKILLed mid-publish -
+
+    def kill_phase(self, fast=False):
+        specs = COMPACT_KILL_SPECS[:2] if fast else COMPACT_KILL_SPECS
+        for spec in specs:
+            self.append_round(120)
+            self.refresh_ref()
+            self.verify('pre-kill [%s]' % spec)
+            if spec.startswith('rollup.'):
+                cmd = ['rollup', '--tree', self.idx,
+                       '--interval', 'day']
+            else:
+                cmd = ['compact', '--tree', self.idx,
+                       '--interval', 'day', '--min-gens', '1']
+            env = dict(os.environ, JAX_PLATFORMS='cpu',
+                       DN_FAULTS=spec, DN_INDEX_FORMAT=self.fmt)
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO_ROOT, 'bin', 'dn.py')] + cmd,
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, timeout=300)
+            self.ops += 1
+            if proc.returncode != -9:
+                self.violate('kill drill [%s]: expected SIGKILL, '
+                             'got rc=%s stderr=%r'
+                             % (spec, proc.returncode,
+                                proc.stderr[-200:]))
+                continue
+            self.kills += 1
+            self.note('SIGKILLed dn %s mid-publish [%s]'
+                      % (cmd[0], spec))
+            mod_journal.reset_sweep_memo()
+            mod_faults.reset()
+            # the recovery sweep runs on the query path; rolled back
+            # OR rolled forward, the bytes must not move
+            self.verify('post-kill [%s]' % spec)
+            self.check_litter('post-kill [%s]' % spec)
+
+    # -- the final seal: compacted tree == from-scratch build ---------
+
+    def check_tree_equality(self):
+        """After a clean converge compaction the live tree's shards
+        byte-equal the from-scratch build, name for name (follow/
+        quarantine/rollup state and durable metadata excluded — the
+        reference tree has none)."""
+        def tree_bytes(idx):
+            out = {}
+            for r, dirs, names in os.walk(idx):
+                for skip in (mod_journal.FOLLOW_DIR,
+                             mod_journal.QUARANTINE_DIR,
+                             mod_journal.ROLLUP_DIR):
+                    if skip in dirs:
+                        dirs.remove(skip)
+                for name in sorted(names):
+                    if mod_journal.is_durable_metadata(name):
+                        continue
+                    p = os.path.join(r, name)
+                    with open(p, 'rb') as f:
+                        out[os.path.relpath(p, idx)] = f.read()
+            return out
+
+        mod_journal.reset_sweep_memo()
+        got = tree_bytes(self.idx)
+        ref = tree_bytes(self.ref_idx)
+        if sorted(got) != sorted(ref):
+            self.violate('compacted tree shard set differs from the '
+                         'from-scratch build: %d vs %d shards'
+                         % (len(got), len(ref)))
+            return
+        diff = [k for k in ref if got[k] != ref[k]]
+        if diff:
+            self.violate('compacted shard bytes diverge from the '
+                         'from-scratch build: %s' % diff[:4])
+        else:
+            self.note('compacted tree byte-equals the from-scratch '
+                      'build (%d shards)' % len(ref))
+
+    def armed_offline_round(self):
+        """One append + armed offline compaction — top-up volume for
+        the injected-fault floor; retries until the pass lands."""
+        env = self._env_block()
+        prior = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        arm = os.environ.get('DN_FAULTS')
+        os.environ['DN_FAULTS'] = COMPACT_ERR_SPEC
+        mod_faults.reset()
+        try:
+            self.append_round(120)
+            for attempt in range(10):
+                rc, out, err = run_cli(['compact', '--tree', self.idx,
+                                        '--interval', 'day',
+                                        '--min-gens', '1'])
+                self.ops += 1
+                if rc == 0:
+                    return
+                text = err.decode('utf-8', 'replace')
+                if 'Traceback' in text or 'dn:' not in text:
+                    self.violate('top-up compact unclean: %r'
+                                 % text[-300:])
+                    return
+                self.clean_errors += 1
+            self.violate('top-up compact never converged')
+        finally:
+            if arm is None:
+                os.environ.pop('DN_FAULTS', None)
+            else:
+                os.environ['DN_FAULTS'] = arm
+            mod_faults.reset()
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def final_seal(self):
+        """Re-verify + tree equality with the drill env installed
+        (used after top-up rounds mutate the tree again)."""
+        env = self._env_block()
+        prior = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            self.refresh_ref()
+            self.verify('final')
+            self.check_litter('final')
+            self.check_tree_equality()
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def run(self, fast=False):
+        env = self._env_block()
+        prior = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            self.append_round(400 if fast else 900)   # base shards
+            self.refresh_ref()
+            self.verify('seed')
+            self.serve_phase(fast=fast)
+            self.kill_phase(fast=fast)
+            # converge: a clean offline compaction of whatever the
+            # kill drills left pending, then the seal
+            for interval in ('day', 'hour'):
+                rc, out, err = run_cli(['compact', '--tree', self.idx,
+                                        '--interval', interval,
+                                        '--min-gens', '1'])
+                self.ops += 1
+                if rc != 0:
+                    self.violate('converge compact (%s) failed: %r'
+                                 % (interval, err[-300:]))
+            self.refresh_ref()
+            self.verify('converged')
+            self.check_litter('converged')
+            self.check_tree_equality()
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+
+def soak_compact(root, fast=False, verbose=True, floor=None):
+    """The background-compaction drill; returns the summary dict."""
+    mod_faults.reset()
+    rc_path = os.path.join(root, 'dragnetrc.json')
+    os.environ['DRAGNET_CONFIG'] = rc_path
+    formats = ('dnc',) if fast else FORMATS
+    soaks = []
+    for fmt in formats:
+        s = CompactSoak(root, fmt, verbose=verbose)
+        s.run(fast=fast)
+        soaks.append(s)
+    kills = sum(s.kills for s in soaks)
+    if floor:
+        # top-up: armed offline compaction rounds until the
+        # injected-fault floor is met (each round re-creates
+        # generation groups for the armed pass to chew through)
+        s = soaks[-1]
+        extra = 0
+        while extra < 60 and kills + mod_vpipe.global_counters() \
+                .get('faults injected', 0) < floor:
+            s.armed_offline_round()
+            extra += 1
+        if extra:
+            s.note('%d top-up armed compaction rounds' % extra)
+            s.final_seal()
+    counters = mod_vpipe.global_counters()
+    inproc = counters.get('faults injected', 0)
+    summary = {
+        'ops': sum(s.ops for s in soaks),
+        'kills': kills,
+        'clean_errors': sum(s.clean_errors for s in soaks),
+        'violations': sum((s.violations for s in soaks), []),
+        'faults_injected_total': inproc + kills,
+        'faults_injected_in_process': inproc,
+        'generations_appended':
+            counters.get('follow generations appended', 0),
+        'shards_compacted':
+            counters.get('index shards compacted', 0),
+        'generations_removed':
+            counters.get('index generations removed', 0),
+        'rollup_shards_built':
+            counters.get('rollup shards built', 0),
+        'recovery': {
+            k: counters.get(k, 0)
+            for k in ('index recovery rollbacks',
+                      'index recovery rollforwards',
+                      'index tmps quarantined')},
+    }
+    return summary
+
+
 # -- resource-exhaustion drill (disk governance + read-only serving) --------
 
 class ResourceSoak(ClusterSoak):
@@ -2511,6 +3021,14 @@ def main(argv=None):
     p.add_argument('--follow', action='store_true',
                    help='run the continuous-ingest (dn follow) '
                         'drill instead of the single-process soak')
+    p.add_argument('--compact', action='store_true',
+                   help='run the background-compaction drill '
+                        '(follow --append mini-generations under '
+                        'remote query flood while a serve-resident '
+                        'compactor and rollup builder rewrite the '
+                        'tree with armed publish faults; subprocess '
+                        'dn compact/rollup SIGKILLed mid-publish) '
+                        'instead of the single-process soak')
     p.add_argument('--overload', action='store_true',
                    help='run the multi-tenant overload flood '
                         '(~5x capacity, tenant weights, torn-frame/'
@@ -2548,6 +3066,8 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.follow:
         default_floor = 20 if args.fast else 100
+    elif args.compact:
+        default_floor = 4 if args.fast else 20
     elif args.overload:
         default_floor = 15 if args.fast else 60
     elif args.rebalance:
@@ -2565,6 +3085,7 @@ def main(argv=None):
     t0 = time.time()
     runner = soak_cluster if args.cluster \
         else soak_follow if args.follow \
+        else soak_compact if args.compact \
         else soak_overload if args.overload \
         else soak_rebalance if args.rebalance \
         else soak_scrub if args.scrub \
