@@ -1595,6 +1595,9 @@ def cmd_serve(ctx, argv):
     res_conf = mod_config.resources_config()
     if isinstance(res_conf, DNError):
         fatal(res_conf)
+    dev_conf = mod_config.device_config()
+    if isinstance(dev_conf, DNError):
+        fatal(dev_conf)
 
     cluster = opts.cluster or os.environ.get('DN_SERVE_TOPOLOGY') \
         or None
@@ -1695,6 +1698,33 @@ def cmd_serve(ctx, argv):
                res_conf['disk_critical_pct'], res_conf['poll_ms'],
                res_conf['mem_budget_mb'], res_conf['fd_headroom'],
                obs_conf['events_file_max_mb']))
+        # the device lane's serving picture: backend identity (probed
+        # under a short deadline ONLY when the engine could actually
+        # reach the device — a wedged plugin costs 5s here, and a
+        # host-only rig pays no backend initialization at all), the
+        # HBM residency budget, and the persisted audition cache
+        from . import device_scan as mod_ds
+        from . import engine as mod_engine
+        from .ops import accelerator_likely
+        mode = (mod_engine.engine_mode() or 'auto').strip().lower()
+        possible = mode == 'jax' or (mode == 'auto'
+                                     and accelerator_likely())
+        if possible:
+            status, backend = mod_ds.run_with_deadline(
+                mod_ds._backend_id, 5.0, 'validate-backend-id')
+            backend = backend if status == 'ok' and backend \
+                else 'unprobed'
+        else:
+            backend = 'host-only'
+        apath, entries, wins = mod_ds.audition_cache_entries()
+        sys.stdout.write(
+            'device lane ok: engine=%s backend=%s residency_mb=%d '
+            'prewarm=%d probe_timeout_s=%d audition_cache=%s '
+            'entries=%d wins=%d\n'
+            % (mode, backend, dev_conf['residency_mb'],
+               1 if dev_conf['prewarm'] else 0,
+               dev_conf['probe_timeout_s'], apath or 'off',
+               entries, wins))
         if topo is not None:
             sys.stdout.write(
                 'cluster topology ok: member=%s epoch=%d assign=%s '

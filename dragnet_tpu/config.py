@@ -785,6 +785,67 @@ def resources_config(env=None):
     return rv
 
 
+# --- device-lane knobs (residency, pre-warm, probe/audition tuning) ---
+#
+# Same contract as the serve/resource knobs: parsed and validated in
+# one place, checked up front by `dn serve --validate`.  device_scan
+# and serve/residency.py read the env forgivingly at runtime; THIS is
+# where malformed values are rejected with the shared DNError contract.
+
+_DEVICE_KNOBS = [
+    # HBM byte budget for serve-time residency (pinned accumulators);
+    # 0 disables — the device lane uploads/fetches per request
+    ('DN_DEVICE_RESIDENCY_MB', 'int', 0, 0),
+    # compile the stacked index-query programs and report the audition
+    # cache at serve bind, before the first request
+    ('DN_DEVICE_PREWARM', 'bool', True, None),
+    # hard deadline for backend probes and the serve pre-warm (a
+    # wedged plugin costs a bounded wait, never a hung server)
+    ('DN_DEVICE_PROBE_TIMEOUT', 'int', 420, 1),
+    # wall-clock freshness of persisted audition verdicts
+    ('DN_AUDITION_TTL_S', 'int', 86400, 0),
+]
+
+
+def device_config(env=None):
+    """The resolved device-lane knobs (keys: residency_mb, prewarm,
+    probe_timeout_s, audition_ttl_s), or DNError on the first
+    malformed value — the shared fail-fast contract `dn serve
+    --validate` checks."""
+    if env is None:
+        env = os.environ
+    keys = {'DN_DEVICE_RESIDENCY_MB': 'residency_mb',
+            'DN_DEVICE_PREWARM': 'prewarm',
+            'DN_DEVICE_PROBE_TIMEOUT': 'probe_timeout_s',
+            'DN_AUDITION_TTL_S': 'audition_ttl_s'}
+    rv = {}
+    for name, kind, default, minimum in _DEVICE_KNOBS:
+        key = keys[name]
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        if kind == 'bool':
+            low = raw.strip().lower()
+            if low in ('1', 'true', 'yes', 'on'):
+                rv[key] = True
+            elif low in ('0', 'false', 'no', 'off'):
+                rv[key] = False
+            else:
+                return DNError('%s: expected a boolean (0/1), got '
+                               '"%s"' % (name, raw))
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            value = minimum - 1
+        if value < minimum:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        rv[key] = value
+    return rv
+
+
 # --- observability knobs (DN_TRACE / DN_SLOW_MS / DN_METRICS_BUCKETS) -
 #
 # Same contract as the serve/remote knobs: parsed and validated in one

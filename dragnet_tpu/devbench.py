@@ -18,6 +18,10 @@ physical quantities:
   countable (2 * padded_records * padded_segments per batch, see
   ops/pallas_kernels.py); MFU is reported against the chip's bf16 peak
   when the platform is recognized (DN_TPU_PEAK_FLOPS overrides).
+* reupload contrast — the same dispatch with a fresh H2D upload of
+  every input per iteration (the per-request, non-resident serving
+  shape); residency_speedup = reupload / resident time is what the
+  serve-time HBM pinning (serve/residency.py) banks per repeat.
 
 Set DN_BENCH_TRACE=<dir> to record a jax.profiler trace of the
 kernel-resident loop.
@@ -152,6 +156,20 @@ def kernel_bench(datafile, query_conf=None, iters=32, max_records=None):
     if ctx is not None:
         ctx.__exit__(None, None, None)
 
+    # ---- reupload contrast: what the per-request (non-resident)
+    # serving shape pays — a fresh H2D upload of every input before
+    # each dispatch.  kernel_s / reupload_s is the residency speedup
+    # the serve-time pinning (serve/residency.py) banks per repeat.
+    rep_iters = max(1, iters // 4)
+    t0 = time.monotonic()
+    b = acc
+    for _ in range(rep_iters):
+        up = dict(inputs)
+        up.update(jax.device_put(np_inputs))
+        b = run(up, b)
+    jax.block_until_ready(b)
+    reupload_s = (time.monotonic() - t0) / rep_iters
+
     # ---- D2H: fetch the (fresh) accumulator ------------------------
     d2h_bytes = sum(int(np.prod(x.shape)) * x.dtype.itemsize
                     for x in a)
@@ -177,6 +195,8 @@ def kernel_bench(datafile, query_conf=None, iters=32, max_records=None):
         'h2d_gb_per_sec': h2d_bytes / h2d_s / 1e9,
         'h2d_bytes_per_record': h2d_bytes / n,
         'd2h_mb_per_sec': d2h_bytes / d2h_s / 1e6,
+        'reupload_records_per_sec': n / reupload_s,
+        'residency_speedup': reupload_s / kernel_s,
         'device_kind': getattr(jax.devices()[0], 'device_kind', ''),
         'platform': jax.devices()[0].platform,
     }

@@ -208,9 +208,13 @@ def audition_cache_get(key):
 def audition_cache_put(key, won, device_rate=None, host_rate=None):
     """Persist an audition (or probation-crossover) verdict.  Expired
     entries are pruned on write; the file is swapped atomically
-    (tmp+rename) so concurrent CLI invocations never read torn JSON.
-    Best-effort: an unwritable cache directory silently disables
-    persistence (the in-process decision already happened)."""
+    (tmp+rename) so concurrent CLI invocations never read torn JSON,
+    and the read-modify-write runs under a `.lock` sidecar flock so
+    two concurrent writers (`dn serve` pre-warm and a `dn build`, say)
+    cannot silently drop each other's verdicts — the same lost-update
+    class the integrity catalog already guards against.  Best-effort:
+    an unwritable cache directory (or a flock-less filesystem) never
+    blocks the in-process decision that already happened."""
     path = _audition_cache_file()
     if path is None:
         return
@@ -218,35 +222,95 @@ def audition_cache_put(key, won, device_rate=None, host_rate=None):
     import os
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        lockf = None
         try:
-            with open(path) as f:
-                data = json.load(f)
-            if not isinstance(data, dict):
-                data = {}
+            lockf = open(path + '.lock', 'a')
+            import fcntl
+            fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
         except Exception:
-            data = {}
-        now = time.time()
-        ttl = _audition_ttl_s()
-        data = {k: v for k, v in data.items()
-                if isinstance(v, dict)
-                and now - float(v.get('ts', 0)) <= ttl}
-        data[key] = {'won': bool(won), 'ts': now,
-                     'device_rate': _rate_field(device_rate),
-                     'host_rate': _rate_field(host_rate)}
-        tmp = '%s.%d' % (path, os.getpid())
+            pass        # best-effort on filesystems without flock
         try:
-            with open(tmp, 'w') as f:
-                json.dump(data, f)
-            os.rename(tmp, path)
-        except Exception:
-            # crash hygiene (the index sinks' tmp contract): a failed
-            # write/rename must not strand `<name>.<pid>` litter
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+                with open(path) as f:
+                    data = json.load(f)
+                if not isinstance(data, dict):
+                    data = {}
+            except Exception:
+                data = {}
+            now = time.time()
+            ttl = _audition_ttl_s()
+            data = {k: v for k, v in data.items()
+                    if isinstance(v, dict)
+                    and now - float(v.get('ts', 0)) <= ttl}
+            data[key] = {'won': bool(won), 'ts': now,
+                         'device_rate': _rate_field(device_rate),
+                         'host_rate': _rate_field(host_rate)}
+            tmp = '%s.%d' % (path, os.getpid())
+            try:
+                with open(tmp, 'w') as f:
+                    json.dump(data, f)
+                os.rename(tmp, path)
+            except Exception:
+                # crash hygiene (the index sinks' tmp contract): a
+                # failed write/rename must not strand litter
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        finally:
+            if lockf is not None:
+                lockf.close()       # releases the flock
     except Exception:
         pass
+
+
+def _audition_entries_raw():
+    """The fresh (unexpired) entries of the persisted audition cache,
+    or {}.  All failures read as empty — reporting helpers only."""
+    path = _audition_cache_file()
+    if path is None:
+        return None, {}
+    import json
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            return path, {}
+    except Exception:
+        return path, {}
+    now = time.time()
+    ttl = _audition_ttl_s()
+    return path, {k: v for k, v in data.items()
+                  if isinstance(v, dict) and 'won' in v
+                  and now - float(v.get('ts', 0)) <= ttl}
+
+
+def audition_cache_entries():
+    """(path, fresh entries, fresh wins) of the persisted audition
+    cache — `dn serve --validate`, the serve pre-warm doc, and the
+    bench artifact all report it; (None, 0, 0) when disabled."""
+    path, data = _audition_entries_raw()
+    if path is None:
+        return None, 0, 0
+    wins = sum(1 for v in data.values() if v.get('won'))
+    return path, len(data), wins
+
+
+def audition_cache_shape_hint(shape):
+    """Whether ANY backend ever auditioned this query shape: True when
+    some fresh entry for `shape` won, False when entries exist and all
+    lost, None when the shape was never auditioned.  A HEURISTIC only
+    — the full shape+backend key still gates the actual takeover (a
+    verdict measured on one chip must not route another); this hint
+    only decides how eagerly auto mode starts probing, which is safe
+    on a mismatch because the real audition still runs."""
+    _, data = _audition_entries_raw()
+    prefix = shape + '@'
+    verdicts = [bool(v.get('won')) for k, v in data.items()
+                if k.startswith(prefix)]
+    if not verdicts:
+        return None
+    return True if any(verdicts) else False
 
 # jitted scan programs are shared across DeviceScan instances (a CLI
 # `dn scan` and the bench's repeat runs would otherwise re-trace and
@@ -420,6 +484,8 @@ class DeviceScan(VectorScan):
         self._escalated = False
         self._probe_thread = None
         self._probe_result = None
+        self._probe_retries = 0   # backend_reset recoveries attempted
+        self.probe_status = None  # 'ok'/'refused'/'error'/'timeout'
         self._progress = None     # (bytes_done, bytes_total) from stream
         self._shadow_ctx = None   # set by enable_shadow (MT path)
         self._shadow = None
@@ -534,7 +600,7 @@ class DeviceScan(VectorScan):
         n = provider.n
         self._records_seen += n
         if not self._disabled and \
-                self._records_seen > self.ESCALATE_RECORDS and \
+                self._records_seen > self._escalate_records() and \
                 self._engage_device():
             if self._try_device(provider, weights, alive):
                 self._after_device_batch(n)
@@ -692,8 +758,14 @@ class DeviceScan(VectorScan):
         from the multithreaded host executor (auto mode integration;
         see datasource_file._scan_native)."""
         return (not self._disabled and
-                self._records_seen > self.ESCALATE_RECORDS and
+                self._records_seen > self._escalate_records() and
                 self._engage_device())
+
+    def _escalate_records(self):
+        """The record threshold before the device path is considered;
+        AutoDeviceScan lowers it when a persisted audition verdict
+        already proved this query shape wins on a device."""
+        return self.ESCALATE_RECORDS
 
     def _engage_device(self):
         """Forced mode: probe the backend synchronously on the first
@@ -715,6 +787,21 @@ class DeviceScan(VectorScan):
             ok = is_accelerator()
         return bool(ok)
 
+    def _probe_with_retry(self):
+        """_probe_ok with ONE bounded recovery attempt: a CLEAN
+        refusal (backend answered, said no) gets a backend_reset() and
+        a re-probe — transient plugin-init hiccups recover in-process.
+        Raised exceptions propagate (the deadline wrapper classifies
+        them); a reset cannot unwedge a HUNG op, so timeouts never
+        reach here.  Records the attempt count for attribution."""
+        ok = self._probe_ok()
+        if not ok:
+            from .ops import backend_reset
+            backend_reset()
+            self._probe_retries = 1
+            ok = self._probe_ok()
+        return ok
+
     def _probe_backend(self):
         """One-time lazy backend probe (first batch past the escalation
         threshold).  False permanently disables the device path.
@@ -723,13 +810,15 @@ class DeviceScan(VectorScan):
         under the bench probe deadline (DN_DEVICE_PROBE_TIMEOUT).  A
         hung device plugin under DN_ENGINE=jax used to hang `dn scan`
         indefinitely here; now it warns and falls back to the host
-        engine, which computes identical results."""
+        engine, which computes identical results.  The wedge reason
+        survives in `probe_status` (and the probe-stage span) so a
+        skipped device lane stays attributable after the fact."""
         from .obs import metrics as obs_metrics
         with obs_metrics.timed_stage('device_scan.probe') as sp:
-            status, ok = run_with_deadline(self._probe_ok,
+            status, ok = run_with_deadline(self._probe_with_retry,
                                            probe_deadline_s(),
                                            'backend-probe')
-            sp.set(status=status)
+            sp.set(status=status, retries=self._probe_retries)
         if status == 'timeout':
             import sys
             sys.stderr.write(
@@ -739,7 +828,12 @@ class DeviceScan(VectorScan):
             ok = False
         elif status == 'error':
             ok = False
+        if ok:
+            self.probe_status = 'ok'
+        else:
+            self.probe_status = status if status != 'ok' else 'refused'
         LOG.debug('backend probe', ok=ok, status=status,
+                  retries=self._probe_retries,
                   records_seen=self._records_seen)
         self._backend_ok = ok
         if not ok:
@@ -2230,7 +2324,7 @@ class DeviceScanStack(object):
             s._records_seen += n
             try:
                 ok = (not s._disabled and
-                      s._records_seen > s.ESCALATE_RECORDS and
+                      s._records_seen > s._escalate_records() and
                       s._engage_device())
             finally:
                 s._records_seen -= n
@@ -2452,6 +2546,14 @@ class AutoDeviceScan(DeviceScan):
     # beats the observed host rate by this factor (hysteresis — a
     # near-tie is not worth the transition)
     SHADOW_MARGIN = 1.15
+    # warm start: when the persisted audition cache says this query
+    # shape already WON on a device, escalate much earlier (the
+    # compile is in the XLA cache, the verdict is measured — the
+    # half-million-record detour only re-pays overheads a previous
+    # run already amortized).  The full shape+backend key still gates
+    # the actual takeover, so a backend mismatch merely re-auditions.
+    WARM_ESCALATE_RECORDS = 1 << 16
+    WARM_MIN_REMAINING_SECONDS = 0.75
 
     def enable_shadow(self, make_scans, make_provider, make_weights,
                       make_alive=None):
@@ -2467,14 +2569,13 @@ class AutoDeviceScan(DeviceScan):
         if sp is not None and not sp.done:
             sp.feed(snap, n)
 
-    def _audition_key(self):
-        """Cache key of this scan's audition: the program-shaping query
-        structure (breakdown plans, predicate ASTs, synthetic fields,
-        time-boundedness) plus the backend identity — the pair that
-        determines which side wins on a given rig."""
+    def _audition_shape(self):
+        """The program-shaping query structure (breakdown plans,
+        predicate ASTs, synthetic fields, time-boundedness) — the
+        backend-independent half of the audition key."""
         plans = [(p.kind, p.name, p.field, p.step)
                  for p in (self._plans or [])]
-        shape = jsv.json_stringify([
+        return jsv.json_stringify([
             plans,
             jsv.json_stringify(self.ds_pred.ast)
             if self.ds_pred is not None else None,
@@ -2483,7 +2584,30 @@ class AutoDeviceScan(DeviceScan):
             [[s['name'], s['field']] for s in self.synthetic],
             self.time_bounds is not None,
         ])
-        return shape + '@' + _backend_id()
+
+    def _audition_key(self):
+        """Cache key of this scan's audition: the query shape plus the
+        backend identity — the pair that determines which side wins on
+        a given rig.  Initializes the backend (_backend_id), so only
+        call it after the probe succeeded."""
+        return self._audition_shape() + '@' + _backend_id()
+
+    def _warm_hint(self):
+        """Memoized shape-only audition-cache lookup — safe BEFORE the
+        backend probe (no jax initialization): it only tunes how
+        eagerly this scan escalates; the full shape+backend verdict
+        still gates the takeover itself."""
+        hint = getattr(self, '_warm_hint_memo', ())
+        if hint == ():
+            hint = audition_cache_shape_hint(self._audition_shape())
+            self._warm_hint_memo = hint
+        return hint
+
+    def _escalate_records(self):
+        if self._warm_hint() is True:
+            return min(self.ESCALATE_RECORDS,
+                       self.WARM_ESCALATE_RECORDS)
+        return self.ESCALATE_RECORDS
 
     def _record_crossover(self, won, rate):
         audition_cache_put(self._audition_key(), won,
@@ -2589,28 +2713,41 @@ class AutoDeviceScan(DeviceScan):
 
     def _async_probe(self):
         """Background backend probe; publishes a bool to
-        _probe_result (single assignment, read by the stream thread)."""
+        _probe_result (single assignment, read by the stream thread).
+        Shares the forced path's bounded backend-reset recovery: a
+        clean plugin-init refusal gets one reset + re-probe before the
+        verdict sticks."""
         try:
-            self._probe_result = self._probe_ok()
+            self._probe_result = self._probe_with_retry()
         except Exception:
             self._probe_result = False
 
     def _worth_switching(self):
         """Estimated remaining host-engine time exceeds the switch
         overhead.  Uses the stream's byte progress when available;
-        falls back to a deep-stream record threshold."""
+        falls back to a deep-stream record threshold.  A warm cached
+        win lowers both bars: the compile and the measurement that the
+        switch overhead pays for already happened in a previous run."""
         if self._t0 is None or not self._records_seen:
             return False
         elapsed = time.monotonic() - self._t0
         if elapsed <= 0:
             return False
+        warm = self._warm_hint() is True
         rate = self._records_seen / elapsed
         prog = self._progress
+        # the warm thresholds only ever LOWER the bar (min): a cached
+        # win must never make auto more reluctant than a cold start
         if prog and prog[0] > 0 and prog[1] > 0:
             est_total = self._records_seen * (prog[1] / prog[0])
             remaining = max(0.0, est_total - self._records_seen)
-            return remaining / rate >= self.MIN_REMAINING_SECONDS
-        return self._records_seen >= self.UNKNOWN_SIZE_RECORDS
+            return remaining / rate >= (
+                min(self.MIN_REMAINING_SECONDS,
+                    self.WARM_MIN_REMAINING_SECONDS)
+                if warm else self.MIN_REMAINING_SECONDS)
+        return self._records_seen >= (
+            min(self.UNKNOWN_SIZE_RECORDS, self.WARM_ESCALATE_RECORDS)
+            if warm else self.UNKNOWN_SIZE_RECORDS)
 
 
 def scan_class():

@@ -440,13 +440,28 @@ def _sums_program(pn, pu):
     return prog
 
 
+def _residency():
+    """The serve-installed device residency manager, or None (bare
+    CLI processes never configure one — the lazy import is the whole
+    cost of asking)."""
+    from .serve import residency as mod_residency
+    return mod_residency.active()
+
+
 def _device_sums(inv, weights, nuniq):
     """Per-tuple weight sums on the device, or None for the host
     bincount.  Sums run in i64 (x64 mode), so for the integer weights
     the stacked gate admits the result is bit-equal to the host path
     — the same exactness contract as device_scan.py.  The first
     device op runs under the probe deadline: a wedged backend warns
-    and falls back instead of hanging `dn query`."""
+    and falls back instead of hanging `dn query`.
+
+    Inside a residency-armed `dn serve` (serve/residency.py), the
+    folded accumulator stays pinned in device memory keyed by the
+    content of the staged columns: a request over the same stacked
+    rows skips the H2D upload, the dispatch, AND the slow D2H fetch —
+    it answers with the exact host array the first execution fetched,
+    while the writer epoch retires pins on any index write."""
     from .engine import MAX_DENSE_SEGMENTS
     if nuniq > MAX_DENSE_SEGMENTS or len(inv) == 0:
         return None
@@ -466,12 +481,30 @@ def _device_sums(inv, weights, nuniq):
     w = np.zeros(pn, dtype=np.int64)
     w[:len(inv)] = weights.astype(np.int64)
 
+    res = _residency()
+    rkey = repoch = None
+    if res is not None:
+        from . import index_query_mt as mod_iqmt
+        from .serve import residency as mod_residency
+        rkey = mod_residency.content_key('iq-sums', (seg, w),
+                                         (pn, pu, nuniq))
+        repoch = mod_iqmt.cache_epoch()
+        pinned = res.get(rkey, repoch)
+        if pinned is not None:
+            # the pinned copy is shared across requests; hand out a
+            # private clone (downstream aggregation may scale it)
+            return pinned.copy()
+
     def compute():
         from .ops import backend_ready
         if not backend_ready():
             return None
         dense = _sums_program(pn, pu)(seg, w)
-        return np.asarray(dense)
+        try:
+            dense.block_until_ready()
+        except AttributeError:
+            pass
+        return dense
 
     if st['ready'] is None:
         from .device_scan import run_with_deadline, probe_deadline_s
@@ -499,7 +532,14 @@ def _device_sums(inv, weights, nuniq):
             st['ready'] = False
             _warn_device('backend failed to initialize')
             return None
-    return dense[:nuniq].astype(np.float64)
+    host = np.asarray(dense)[:nuniq].astype(np.float64)
+    if res is not None:
+        # pin the device-side accumulator + its fetched copy; future
+        # hits book the upload and fetch bytes this execution paid
+        res.put(rkey, repoch, dense, host,
+                h2d_bytes=seg.nbytes + w.nbytes)
+        return host.copy()
+    return host
 
 
 def _aggregate_weights(inv, weights, nuniq, stage=None):

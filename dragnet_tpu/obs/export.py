@@ -33,8 +33,11 @@ def _json_name(name, labels):
 def stats_section(registry=None, counters=None):
     """The /stats ``metrics`` document.  When `counters` (the hidden
     vpipe global counters) is given, the device gauges are refreshed
-    from it first, so every export carries the current
-    engagement/residency picture."""
+    from it first, so every export carries the current engagement
+    picture — including the HBM residency gauges
+    (device_residency_hit_rate, device_pinned_bytes, and the
+    h2d/d2h_saved transport counters) once a serve process has
+    configured serve/residency.py."""
     if registry is None:
         registry = mod_metrics.global_registry()
     if counters is not None:

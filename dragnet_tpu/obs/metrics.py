@@ -314,6 +314,19 @@ _DEVICE_COUNTER_GAUGES = (
     ('index device sums', 'device_index_sums'),
 )
 
+# serve/residency.py registers its stats() here at configure time (and
+# clears it at drain) — obs stays import-independent of the serve
+# package while the device gauges still see pinned-memory truth
+_RESIDENCY_SOURCE = None
+
+
+def set_residency_source(fn):
+    """Install (or clear, fn=None) the device-residency stats provider
+    refresh_device_gauges consults: a zero-arg callable returning the
+    serve/residency.py stats doc."""
+    global _RESIDENCY_SOURCE
+    _RESIDENCY_SOURCE = fn
+
 
 def refresh_device_gauges(counters, registry=None):
     """Wire the device-lane engagement picture into typed gauges from
@@ -331,6 +344,10 @@ def refresh_device_gauges(counters, registry=None):
       lane) and a calibrated peak, this reports 0.0 rather than a
       guess.  device_scan sets `device_records_per_sec` when the
       device lane actually measures a window.
+    * ``device_residency_hit_rate`` / ``device_pinned_bytes`` /
+      ``device_h2d_saved_bytes`` / ``device_d2h_saved_bytes`` — HBM
+      residency (serve/residency.py), present only when a serve
+      process has configured it (set_residency_source).
     """
     reg = registry if registry is not None else _GLOBAL
     total_dev = 0
@@ -358,6 +375,21 @@ def refresh_device_gauges(counters, registry=None):
         peak = 0.0
     mfu = 100.0 * rate / peak if (rate > 0 and peak > 0) else 0.0
     reg.set_gauge('device_mfu_pct', mfu)
+    src = _RESIDENCY_SOURCE
+    if src is not None:
+        try:
+            rs = src() or {}
+        except Exception:
+            rs = {}
+        if rs.get('enabled'):
+            reg.set_gauge('device_residency_hit_rate',
+                          float(rs.get('hit_rate', 0.0) or 0.0))
+            reg.set_gauge('device_pinned_bytes',
+                          float(rs.get('bytes', 0) or 0))
+            reg.set_gauge('device_h2d_saved_bytes',
+                          float(rs.get('h2d_saved_bytes', 0) or 0))
+            reg.set_gauge('device_d2h_saved_bytes',
+                          float(rs.get('d2h_saved_bytes', 0) or 0))
 
 
 def refresh_rollup_gauges(counters, registry=None):

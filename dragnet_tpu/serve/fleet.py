@@ -89,6 +89,13 @@ def _member_row(name, st, latency=None):
     rcache = ((st.get('caches') or {}).get('results')) or {}
     if rcache.get('enabled'):
         row['cache_hit_rate'] = rcache.get('hit_rate')
+    # device-lane serving: HBM residency per member (absent rows mean
+    # the member never configured it — honest absence, like the
+    # result cache)
+    resid = ((st.get('device') or {}).get('residency')) or {}
+    if resid.get('enabled'):
+        row['device_residency_hit_rate'] = resid.get('hit_rate')
+        row['device_pinned_bytes'] = resid.get('bytes')
     roll = st.get('rollup') or {}
     if roll:
         row['rollup_coverage'] = roll.get('coverage_ratio')
@@ -264,6 +271,8 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
     follow = {}
     cache_hits = cache_misses = 0
     cache_on = False
+    resid_hits = resid_misses = resid_pinned = 0
+    resid_on = False
     roll_covered = roll_queried = 0
     compact_backlog = None
     for name in names:
@@ -309,6 +318,12 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
             cache_on = True
             cache_hits += rc.get('hits', 0) or 0
             cache_misses += rc.get('misses', 0) or 0
+        rd = ((st.get('device') or {}).get('residency')) or {}
+        if rd.get('enabled'):
+            resid_on = True
+            resid_hits += rd.get('hits', 0) or 0
+            resid_misses += rd.get('misses', 0) or 0
+            resid_pinned += rd.get('bytes', 0) or 0
         roll = st.get('rollup') or {}
         roll_covered += roll.get('covered_shards', 0) or 0
         roll_queried += roll.get('shards_queried', 0) or 0
@@ -376,6 +391,14 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
         'rollup_coverage': round(roll_covered / roll_queried, 4)
         if roll_queried else 0.0,
         'compact_backlog': compact_backlog,
+        # device-lane serving: HBM residency over SUMMED member
+        # hits/misses + total pinned bytes (None when no member
+        # configured residency — honest absence, like the cache)
+        'device_residency_hit_rate': round(
+            resid_hits / (resid_hits + resid_misses), 4)
+        if resid_on and (resid_hits + resid_misses) else
+        (0.0 if resid_on else None),
+        'device_pinned_bytes': resid_pinned if resid_on else None,
     }
     if agg_latency is not None and agg_latency.total:
         aggregate['latency'] = {
@@ -455,6 +478,12 @@ def fleet_prometheus_text(doc):
         reg.set_gauge('fleet_rollup_coverage', agg['rollup_coverage'])
     if agg.get('compact_backlog') is not None:
         reg.set_gauge('fleet_compact_backlog', agg['compact_backlog'])
+    if agg.get('device_residency_hit_rate') is not None:
+        reg.set_gauge('fleet_device_residency_hit_rate',
+                      agg['device_residency_hit_rate'])
+    if agg.get('device_pinned_bytes') is not None:
+        reg.set_gauge('fleet_device_pinned_bytes',
+                      agg['device_pinned_bytes'])
     lat = agg.get('latency')
     if lat:
         reg.set_gauge('fleet_latency_p50_ms', lat['p50'])

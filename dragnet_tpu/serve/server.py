@@ -79,6 +79,7 @@ from . import ioloop as mod_ioloop
 from . import lifecycle as mod_lifecycle
 from . import protocol as mod_protocol
 from . import qcache as mod_qcache
+from . import residency as mod_residency
 
 MAX_REQUEST_BYTES = mod_protocol.MAX_FRAME_BYTES
 
@@ -331,6 +332,18 @@ class DnServer(object):
         # memory budget.  DN_SERVE_CACHE_MB=0 (default) disables.
         self.qcache = mod_qcache.ResultCache(
             conf['cache_mb'] << 20, governor=self.governor)
+        # device-lane serving (serve/residency.py): pinned HBM
+        # accumulators answer repeat stacked aggregations with zero
+        # transfer either direction, invalidated by the same writer
+        # epoch as the result cache.  The HBM budget is deliberately
+        # NOT charged to the host governor — different resource.
+        # DN_DEVICE_RESIDENCY_MB=0 (default) disables.
+        dev_conf = mod_config.device_config()
+        if isinstance(dev_conf, DNError):
+            raise dev_conf
+        self.device_conf = dev_conf
+        mod_residency.configure(dev_conf['residency_mb'] << 20)
+        self._prewarm_doc = None
         # fleet observability (obs/history.py, obs/events.py,
         # serve/fleet.py): the metric-history snapshotter and the
         # event journal are armed at bind from DN_METRICS_HISTORY_S /
@@ -445,6 +458,16 @@ class DnServer(object):
         # mode transitions stay fresh even on an idle server, and
         # recovery from critical is automatic with no request traffic
         self.governor.start()
+        # serve-time device pre-warm (serve/residency.py): compile
+        # the stacked index-query programs and load the persisted
+        # audition cache on a background thread so the first request
+        # never pays compile or probe latency.  Gated on the engine
+        # being able to reach the device lane at all; bounded by the
+        # probe deadline inside prewarm() — a wedged plugin costs a
+        # bounded background wait, never a hung bind.
+        if self.device_conf['prewarm'] and self._device_lane_possible():
+            threading.Thread(target=self._run_prewarm,
+                             name='dn-prewarm', daemon=True).start()
         hist_s = obs_history.history_interval_s()
         if hist_s > 0:
             self.history = obs_history.HistorySnapshotter(
@@ -525,6 +548,9 @@ class DnServer(object):
         # reserved governor bytes back
         self.qcache.clear()
         mod_iqmt.shard_cache_clear()
+        # drop every pinned device array so the backend can reclaim
+        # the HBM, and deregister the residency gauges
+        mod_residency.deconfigure()
         if self._hook is not None:
             mod_lifecycle.remove_writer_invalidation(self._hook)
             self._hook = None
@@ -534,6 +560,37 @@ class DnServer(object):
         _SERVER_LEAKS.untrack(self)
         self._drained.set()
         self.log.info('drained', requests=self._counters['requests'])
+
+    # -- device lane (serve/residency.py) ---------------------------------
+
+    def _device_lane_possible(self):
+        """Can this process's engine mode ever reach the device lane?
+        Cheap env/topology inspection only — never initializes the
+        backend (that is the pre-warm thread's job, under deadline)."""
+        from .. import engine as mod_engine
+        mode = (mod_engine.engine_mode() or 'auto').strip().lower()
+        if mode == 'jax':
+            return True
+        if mode != 'auto':
+            return False
+        from ..ops import accelerator_likely
+        try:
+            return bool(accelerator_likely())
+        except Exception:
+            return False
+
+    def _run_prewarm(self):
+        try:
+            doc = mod_residency.prewarm(
+                deadline_s=self.device_conf['probe_timeout_s'])
+        except Exception as e:        # honest doc over a dead thread
+            doc = {'state': 'failed', 'error': str(e)}
+        self._prewarm_doc = doc
+        self.log.info('device prewarm', state=doc.get('state'),
+                      backend=doc.get('backend'),
+                      programs=doc.get('programs'),
+                      auditions=doc.get('auditions'),
+                      ms=doc.get('ms'))
 
     # -- dynamic topology -------------------------------------------------
 
@@ -866,6 +923,11 @@ class DnServer(object):
                 'engaged': device_engaged(counters),
                 'signals': {k: counters.get(k, 0)
                             for k in _DEVICE_SIGNALS},
+                # HBM residency + serve-start pre-warm
+                # (serve/residency.py); prewarm is None until the
+                # background thread reports (or when gated off)
+                'residency': mod_residency.stats(),
+                'prewarm': self._prewarm_doc,
             },
             # resource governance (resources.py): mode, per-tree
             # disk view, fd headroom, memory-budget accounting,

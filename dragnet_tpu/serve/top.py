@@ -37,6 +37,18 @@ def _fmt(v, unit='', none='-'):
     return '%s%s' % (v, unit)
 
 
+def _fmt_bytes(v, none='-'):
+    if v is None:
+        return none
+    v = float(v)
+    for unit in ('B', 'KB', 'MB', 'GB'):
+        if v < 1024 or unit == 'GB':
+            return ('%d%s' % (v, unit)) if unit == 'B' \
+                else ('%.1f%s' % (v, unit))
+        v /= 1024.0
+    return none
+
+
 def _member_state(row):
     if not row.get('ok'):
         return 'DOWN'
@@ -97,6 +109,14 @@ def render_frame(doc, ansi=True):
             % (_fmt(agg.get('cache_hit_rate')),
                _fmt(agg.get('rollup_coverage')),
                _fmt(agg.get('compact_backlog'))))
+    # device-lane line: only when some member runs HBM residency
+    # (host-only fleets keep the old frame byte-for-byte)
+    if agg.get('device_residency_hit_rate') is not None or \
+            agg.get('device_pinned_bytes') is not None:
+        lines.append(
+            'device resid hit %s  pinned %s'
+            % (_fmt(agg.get('device_residency_hit_rate')),
+               _fmt_bytes(agg.get('device_pinned_bytes'))))
     if doc.get('members_read_only'):
         lines.append('%sDISK: %d member(s) read-only (min free %s%%)'
                      '%s'

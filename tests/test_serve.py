@@ -807,6 +807,10 @@ def test_serve_validate_ok(monkeypatch):
     monkeypatch.setenv('DN_SERVE_MAX_INFLIGHT', '3')
     monkeypatch.setenv('DN_SERVE_DEADLINE_MS', '2500')
     monkeypatch.delenv('DN_FAULTS', raising=False)
+    # pin the device-lane line: host-only rig, audition cache off
+    monkeypatch.setenv('JAX_PLATFORMS', 'cpu')
+    monkeypatch.delenv('DN_ENGINE', raising=False)
+    monkeypatch.setenv('DN_AUDITION_CACHE', '0')
     rc, out, err = run_cli(['serve', '--validate', '--socket',
                             '/tmp/never-bound.sock'])
     assert rc == 0
@@ -835,7 +839,10 @@ def test_serve_validate_ok(monkeypatch):
                    b'resources config ok: disk_low_pct=10 '
                    b'disk_critical_pct=5 poll_ms=2000 '
                    b'mem_budget_mb=0 fd_headroom=64 '
-                   b'events_file_max_mb=64\n')
+                   b'events_file_max_mb=64\n'
+                   b'device lane ok: engine=auto backend=host-only '
+                   b'residency_mb=0 prewarm=1 probe_timeout_s=420 '
+                   b'audition_cache=off entries=0 wins=0\n')
 
 
 def test_serve_validate_reports_armed_faults(monkeypatch):
