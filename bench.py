@@ -364,11 +364,15 @@ def index_query_bench(tmpdir):
         cache_stats = mod_iqmt.shard_cache_stats()
 
         # per-shard parallel (PR 1's reader pool, DN_IQ_STACK=0) —
-        # the prior serving path, kept as a pinned column
+        # the prior serving path, kept as a pinned column.  The
+        # fan-out self-selects pool vs degraded-sequential from
+        # measured whole-fan-out cost; record the verdict so a
+        # degraded pool is attributable in the artifact
         stack_env('0')
         par_p50, par_p95 = measure(q(), 11)
         par_win_p50, par_win_p95 = measure(
             q('2014-06-01', '2014-07-01'), 11)
+        fanout = mod_iqmt.fanout_stats()
 
         # sequential baseline: DN_IQ_THREADS=0 (uncached
         # open/query/close per shard — what every query paid before
@@ -421,6 +425,16 @@ def index_query_bench(tmpdir):
         'index_query_parallel_p95_ms': round(par_p95, 2),
         'index_query_parallel_window_p50_ms': round(par_win_p50, 2),
         'index_query_parallel_window_p95_ms': round(par_win_p95, 2),
+        # which strategy the parallel legs actually ran (the fan-out
+        # degrades itself to the cached sequential loop when that
+        # measures faster) + the measured per-shard costs behind it
+        'index_query_parallel_mode': fanout['last_mode'],
+        'index_query_pool_ms_per_shard':
+            round(fanout['pool_ms_per_shard'], 4)
+            if fanout['pool_ms_per_shard'] is not None else None,
+        'index_query_seq_ms_per_shard':
+            round(fanout['seq_ms_per_shard'], 4)
+            if fanout['seq_ms_per_shard'] is not None else None,
         'index_query_cold_ms': round(cold_ms, 2),
         'index_query_window_p50_ms': round(stk_win_p50, 2),
         'index_query_window_p95_ms': round(stk_win_p95, 2),
@@ -2105,10 +2119,22 @@ def main():
     device_engaged = dev_batches > 0
 
     # high-cardinality at scale: host sparse/deferred merge vs the
-    # device-resident sparse sort-merge program
+    # device-resident sparse sort-merge program.  The radix merge's
+    # own telemetry (scan_mt._MERGE_STATS) splits the leg into scan
+    # phase (parse + per-batch fold) and merge phase (partition
+    # compaction + ordered emission) — reset first so the warm-up and
+    # large-trio legs don't pollute the split
+    from dragnet_tpu import scan_mt as mod_scan_mt
+    mod_scan_mt.reset_merge_stats()
     hc_host, hc_tuples, _ = timed_scan(
         runs, 'highcard_host', largefile, large_n, HC_QUERY, 'vector',
         repeats=2)
+    hc_merge = mod_scan_mt.merge_stats()
+    # mean merge cost per scan (merge_ms accumulates across repeats);
+    # scan phase = the best rep's wall clock minus that merge share
+    hc_total_ms = large_n / hc_host * 1000.0
+    hc_merge_ms = (hc_merge['merge_ms'] / hc_merge['engaged']
+                   if hc_merge['engaged'] else 0.0)
     if use_device:
         hc_dev, hc_tuples_d, hc_batches = timed_scan(
             runs, 'highcard_device', largefile, large_n, HC_QUERY,
@@ -2188,6 +2214,17 @@ def main():
         'highcard_host_records_per_sec': round(hc_host),
         'highcard_device_engaged': hc_batches > 0,
         'highcard_output_tuples': hc_tuples,
+        # scan-phase vs merge-phase split for the host highcard leg:
+        # merge = the radix partitions' final compaction + ordered
+        # emission (scan_mt.RadixMerge), scan = everything before it
+        # (parse + per-batch fold + partition routing)
+        'highcard_host_total_ms': round(hc_total_ms, 2),
+        'highcard_host_merge_ms': round(hc_merge_ms, 2),
+        'highcard_host_scan_ms':
+            round(max(0.0, hc_total_ms - hc_merge_ms), 2),
+        'highcard_merge_partitions': hc_merge['partitions'],
+        'highcard_merge_rows_in': hc_merge['rows'],
+        'highcard_merge_unique_rows': hc_merge['unique'],
         'build_records_per_sec': round(build_auto),
         'build_host_records_per_sec': round(build_host),
         'build_device_records_per_sec':
@@ -2223,6 +2260,19 @@ def main():
     extra['audition_cache_path'] = apath
     extra['audition_cache_entries'] = aentries
     extra['audition_cache_wins'] = awins
+    # pipelined-dispatch accounting (device legs run in-process):
+    # what fraction of H2D upload bytes were issued while the previous
+    # batch was still computing — the double-buffering win itself
+    from dragnet_tpu.obs import metrics as _obs_metrics
+    _reg = _obs_metrics.global_registry()
+    _h2d = _reg.counter('device_h2d_bytes').value
+    _h2d_ov = _reg.counter('device_h2d_overlapped_bytes').value
+    extra['device_pipe_dispatches'] = \
+        _reg.counter('device_pipe_dispatches').value
+    extra['device_pipe_overlapped'] = \
+        _reg.counter('device_pipe_overlapped').value
+    extra['h2d_overlapped_pct'] = \
+        round(100.0 * _h2d_ov / _h2d, 2) if _h2d else None
     if device_sub is not None:
         extra['device_subprocess_runs'] = device_sub.get('runs')
     extra.update(iq)

@@ -34,6 +34,27 @@ def _unique_rows_2(a, b):
     return first_idx, inv.reshape(-1), None
 
 
+def _unique_1d(vals, span):
+    """np.unique(return_index/inverse) for non-negative int64 codes in
+    [0, span): dense first-occurrence tables in O(n + span) when the
+    span is comparable to n, sort-based otherwise.  Returns
+    (first_idx, inv) with uniques implicitly in ascending code order —
+    exactly np.unique's contract."""
+    n = len(vals)
+    if 0 < span <= max(65536, 4 * n):
+        # reversed fancy assignment: duplicate indexes write last-wins,
+        # so feeding rows in reverse leaves each code's FIRST occurrence
+        first = np.full(span, -1, dtype=np.int64)
+        first[vals[::-1]] = np.arange(n - 1, -1, -1)
+        ids = np.flatnonzero(first >= 0)
+        rank = np.empty(span, dtype=np.int64)
+        rank[ids] = np.arange(len(ids))
+        return first[ids], rank[vals]
+    _, first_idx, inv = np.unique(vals, return_index=True,
+                                  return_inverse=True)
+    return first_idx, inv.reshape(-1)
+
+
 def _is_array_index(s):
     if not s or not s.isdigit():
         return False
@@ -234,8 +255,7 @@ class Aggregator(object):
             # occurrence of the (group, code) pair in arrival order
             if ngroups * span < 2 ** 62:
                 pair = gid * span + pair_code
-                uniq, first_idx, inv = np.unique(
-                    pair, return_index=True, return_inverse=True)
+                first_idx, inv = _unique_1d(pair, ngroups * span)
             else:
                 first_idx, inv, _ = _unique_rows_2(gid, pair_code)
             sk = np.where(nn == 1, first_idx[inv], sk)
@@ -266,11 +286,14 @@ class Aggregator(object):
                     # rows carry ordinal form, not bucket-min
                     cols_out.append(cc.tolist())
                     continue
-                # bucket-min per unique ordinal (few), mapped
+                # bucket-min per unique ordinal (few), gathered through
+                # an object array so the exact Python values bucket_min
+                # returned (int vs float) survive to the output
                 bz = self.bucketizers[name]
-                uniq = np.unique(cc)
-                table = {int(o): bz.bucket_min(int(o)) for o in uniq}
-                cols_out.append([table[int(o)] for o in cc.tolist()])
+                uniq, inv = np.unique(cc, return_inverse=True)
+                mins = np.empty(len(uniq), dtype=object)
+                mins[:] = [bz.bucket_min(int(o)) for o in uniq]
+                cols_out.append(mins[inv.reshape(-1)].tolist())
             else:
                 values = np.asarray(dec[1], dtype=object)
                 cols_out.append(values[cc].tolist())

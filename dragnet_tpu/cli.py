@@ -1725,6 +1725,16 @@ def cmd_serve(ctx, argv):
                1 if dev_conf['prewarm'] else 0,
                dev_conf['probe_timeout_s'], apath or 'off',
                entries, wins))
+        from . import scan_mt as mod_scan_mt
+        sys.stdout.write(
+            'scan pipeline ok: pipeline_depth=%d batch_floor=%s '
+            'partitions=%s scan_threads=%d\n'
+            % (dev_conf['pipeline_depth'],
+               dev_conf['batch_floor'] or 'auto',
+               '%d (auto)' % mod_scan_mt.scan_partitions()
+               if dev_conf['scan_partitions'] == 'auto'
+               else dev_conf['scan_partitions'],
+               mod_scan_mt.scan_threads()))
         if topo is not None:
             sys.stdout.write(
                 'cluster topology ok: member=%s epoch=%d assign=%s '

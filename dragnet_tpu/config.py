@@ -804,20 +804,32 @@ _DEVICE_KNOBS = [
     ('DN_DEVICE_PROBE_TIMEOUT', 'int', 420, 1),
     # wall-clock freshness of persisted audition verdicts
     ('DN_AUDITION_TTL_S', 'int', 86400, 0),
+    # in-flight dispatch window for the pipelined device scan (2 =
+    # double buffering: upload batch N+1 while batch N computes)
+    ('DN_DEVICE_PIPELINE_DEPTH', 'int', 2, 1),
+    # padded-batch floor override in rows (0 = auto-tune from the
+    # measured H2D bandwidth; device_scan._pad_floor)
+    ('DN_DEVICE_BATCH_FLOOR', 'int', 0, 0),
+    # radix partition count for the MT merge funnel (scan_mt);
+    # 'auto' = up to 8, bounded by CPU count
+    ('DN_SCAN_PARTITIONS', 'intauto', 'auto', 1),
 ]
 
 
 def device_config(env=None):
     """The resolved device-lane knobs (keys: residency_mb, prewarm,
-    probe_timeout_s, audition_ttl_s), or DNError on the first
-    malformed value — the shared fail-fast contract `dn serve
-    --validate` checks."""
+    probe_timeout_s, audition_ttl_s, pipeline_depth, batch_floor,
+    scan_partitions), or DNError on the first malformed value — the
+    shared fail-fast contract `dn serve --validate` checks."""
     if env is None:
         env = os.environ
     keys = {'DN_DEVICE_RESIDENCY_MB': 'residency_mb',
             'DN_DEVICE_PREWARM': 'prewarm',
             'DN_DEVICE_PROBE_TIMEOUT': 'probe_timeout_s',
-            'DN_AUDITION_TTL_S': 'audition_ttl_s'}
+            'DN_AUDITION_TTL_S': 'audition_ttl_s',
+            'DN_DEVICE_PIPELINE_DEPTH': 'pipeline_depth',
+            'DN_DEVICE_BATCH_FLOOR': 'batch_floor',
+            'DN_SCAN_PARTITIONS': 'scan_partitions'}
     rv = {}
     for name, kind, default, minimum in _DEVICE_KNOBS:
         key = keys[name]
@@ -835,11 +847,18 @@ def device_config(env=None):
                 return DNError('%s: expected a boolean (0/1), got '
                                '"%s"' % (name, raw))
             continue
+        if kind == 'intauto' and raw.strip().lower() == 'auto':
+            rv[key] = 'auto'
+            continue
         try:
             value = int(raw)
         except ValueError:
             value = minimum - 1
         if value < minimum:
+            if kind == 'intauto':
+                return DNError("%s: expected 'auto' or an integer "
+                               '>= %d, got "%s"' % (name, minimum,
+                                                    raw))
             return DNError('%s: expected an integer >= %d, got "%s"'
                            % (name, minimum, raw))
         rv[key] = value

@@ -305,14 +305,16 @@ class DatasourceFile(object):
                     return rec.drain()
                 return process
 
-            def apply_result(calls):
-                for keys, value in calls:
-                    scanner.aggr.write_key(keys, value)
-
             def new_executor():
+                # one radix merge per executor epoch: finalize() runs
+                # inside finish(), so a device takeover (or the final
+                # drain) always observes this executor's batches fully
+                # merged, in order
+                radix = scan_mt.RadixMerge(scanner)
                 return scan_mt.MTScanExecutor(nworkers, build_worker,
-                                              apply_result, pipeline,
-                                              stage_offset)
+                                              radix.apply_calls,
+                                              pipeline, stage_offset,
+                                              finish_fn=radix.finalize)
 
             def device_batch(src, n):
                 nlines, nbad = parser.counters()
@@ -667,15 +669,21 @@ class DatasourceFile(object):
                     return out
                 return process
 
-            def apply_result(results):
-                for s_main, calls in zip(scanners, results):
-                    for keys, value in calls:
-                        s_main.aggr.write_key(keys, value)
-
             def new_executor():
+                # one radix merge per metric scanner per executor epoch
+                radixes = [scan_mt.RadixMerge(s) for s in scanners]
+
+                def apply_result(results):
+                    for radix, calls in zip(radixes, results):
+                        radix.apply_calls(calls)
+
+                def finish_fn():
+                    for radix in radixes:
+                        radix.finalize()
                 return scan_mt.MTScanExecutor(nworkers, build_worker,
                                               apply_result, pipeline,
-                                              stage_offset)
+                                              stage_offset,
+                                              finish_fn=finish_fn)
 
             def take_over():
                 if not scanners[0].take_over_now():

@@ -65,8 +65,57 @@ I16MAX = 2 ** 15 - 1
 
 # dispatch barrier interval: how many async device batches may be in
 # flight before the submitting thread waits for the accumulator (a
-# block, not a fetch) — bounds pinned input-buffer memory
+# block, not a fetch) — bounds pinned input-buffer memory.  Retained as
+# a hard backstop; the pipeline depth below is the working bound.
 SYNC_EVERY_BATCHES = 32
+
+
+def pipeline_depth():
+    """How many device batches may be in flight before dispatch blocks
+    on the oldest (DN_DEVICE_PIPELINE_DEPTH, default 2): depth 2 is
+    classic double buffering — the host stages and uploads batch N+1
+    while the device folds batch N."""
+    import os
+    v = os.environ.get('DN_DEVICE_PIPELINE_DEPTH', '')
+    if v:
+        try:
+            return max(1, int(v))
+        except ValueError:
+            pass
+    return 2
+
+
+def _acc_ready(acc):
+    """True/False when every/any leaf of a device accumulator reports
+    execution completeness via is_ready(); None when the backend's
+    arrays don't expose it (then overlap cannot be observed)."""
+    saw = None
+    for leaf in acc if isinstance(acc, (tuple, list)) else (acc,):
+        if isinstance(leaf, (tuple, list)):
+            r = _acc_ready(leaf)
+        else:
+            fn = getattr(leaf, 'is_ready', None)
+            r = fn() if callable(fn) else None
+        if r is False:
+            return False
+        if r is not None:
+            saw = True
+    return saw
+
+
+def _donate_kw():
+    """jit kwargs donating the accumulator argument.  Donation lets XLA
+    reuse the previous accumulator's buffers for the next one (no
+    per-batch accumulator alloc while the pipeline keeps several
+    batches in flight); the CPU backend ignores donation with a
+    warning, so only ask for it on real devices."""
+    jax, _ = get_jax()
+    try:
+        if jax.default_backend() == 'cpu':
+            return {}
+    except Exception:
+        return {}
+    return {'donate_argnums': 1}
 
 # device-resident sparse set (high-cardinality mode): initial capacity,
 # growth ceiling.  24 bytes/slot of HBM (a 1M-slot set is 24 MB —
@@ -500,6 +549,7 @@ class DeviceScan(VectorScan):
         self._acc = None              # device-resident (dense, first, cvec)
         self._acc_meta = None         # epoch ('caps', 'cols', 'ns')
         self._acc_batch = 0           # batches folded into the acc
+        self._pipe = collections.deque()  # in-flight completion tokens
         self._leaf_list = []          # [(key, Leaf)] in stable order
         self._leaf_tables = {}        # leaf idx -> (host_len, device arr)
         self._ctabs = {}              # leaf idx -> device i8[16]
@@ -662,6 +712,7 @@ class DeviceScan(VectorScan):
         self._acc_meta = None
         self._acc_batch = 0
         self._sparse_ub = 0
+        self._pipe.clear()
 
     def _drain_pending(self):
         pending = self._pending_flush
@@ -1355,9 +1406,11 @@ class DeviceScan(VectorScan):
 
         # pad every per-record array to a stable capacity (batches can
         # overshoot BATCH_SIZE: the streamer only flushes between
-        # reads); under a mesh, round up so every shard gets an equal
-        # slice
-        pn = BATCH_SIZE
+        # reads); the floor is auto-tuned from the measured H2D
+        # bandwidth so small shards stop uploading BATCH_SIZE worth of
+        # zeros per batch; under a mesh, round up so every shard gets
+        # an equal slice
+        pn = self._pad_floor()
         while pn < n:
             pn <<= 1
         mesh_info = self._device_mesh()
@@ -1378,6 +1431,58 @@ class DeviceScan(VectorScan):
                    tuple(kvalid_profile), use_dstats,
                    (self._sparse_cap if sparse else 0))
         return (pn, profile, tuple(new_caps), ns, total_w)
+
+    def _pad_floor(self):
+        """Smallest staged-batch capacity (a power of two, at most
+        BATCH_SIZE).  Tuned once per scan (shared across a stack via
+        the sticky dict) from the measured H2D bandwidth: padding a
+        2k-record shard to BATCH_SIZE is free on a local backend but
+        costs several ms of link time per batch over a tunneled
+        device, so cap the padding waste at roughly one millisecond of
+        upload (~the fixed dispatch cost).  DN_DEVICE_BATCH_FLOOR
+        overrides the measurement; program caches key on the padded
+        size, so a floor change only ever costs one extra trace."""
+        sk = self._sticky
+        if sk is None:
+            return BATCH_SIZE
+        fl = sk.get('pn_floor')
+        if fl:
+            return fl
+        import os
+        hi = BATCH_SIZE
+        lo = min(4096, hi)
+        fl = 0
+        env = os.environ.get('DN_DEVICE_BATCH_FLOOR', '')
+        if env:
+            try:
+                fl = int(env)
+            except ValueError:
+                fl = 0
+        if fl <= 0:
+            bw = sk.get('h2d_bw')
+            if bw is None:
+                bw = 0.0
+                try:
+                    jax, _ = get_jax()
+                    buf = np.zeros(1 << 20, dtype=np.int8)
+                    t0 = time.monotonic()
+                    jax.block_until_ready(jax.device_put(buf))
+                    dt = max(time.monotonic() - t0, 1e-9)
+                    bw = float(buf.nbytes) / dt
+                except Exception:
+                    LOG.debug('h2d bandwidth probe failed')
+                sk['h2d_bw'] = bw
+            # rows whose upload fits in ~1 ms at ~48 uploaded
+            # bytes/row (the staged i32/i8 column mix)
+            fl = int(bw * 0.001 / 48.0) if bw else hi
+        p = lo
+        while p < fl and p < hi:
+            p <<= 1
+        fl = min(p, hi)
+        sk['pn_floor'] = fl
+        from .obs import metrics as obs_metrics
+        obs_metrics.set_gauge('device_batch_floor', fl)
+        return fl
 
     def _sparse_guard(self, n):
         """Prevent resident-set overflow BEFORE folding a batch: track
@@ -1440,19 +1545,50 @@ class DeviceScan(VectorScan):
         self._ensure_acc(progs.acc_init, caps, ns,
                          sparse_cap=profile[-1])
         inputs[self._pfx + 'base'] = np.int64(self._acc_batch << 32)
-        _note_h2d(sum(int(getattr(v, 'nbytes', 0) or 0)
-                      for v in inputs.values()
-                      if isinstance(v, np.ndarray)))
         if self.capture_next:
+            # capture pre-upload: devbench distinguishes the per-batch
+            # host arrays (H2D measurement) from device-resident tables
+            # by type, so it needs the np view of the inputs
             self.capture_next = False
             self.captured = (run, dict(inputs), staged, use_pallas)
-        self._acc = run(inputs, self._acc)
+        if self._device_mesh() is None:
+            nbytes = _upload_inputs(inputs)
+        else:
+            # mesh shardings are the jit's to decide; keep host arrays
+            nbytes = sum(int(getattr(v, 'nbytes', 0) or 0)
+                         for v in inputs.values()
+                         if isinstance(v, np.ndarray))
+        _note_h2d(nbytes)
+        self._acc, token = run(inputs, self._acc)
         self._acc_batch += 1
+        self._note_dispatch(token, nbytes)
         if self._acc_batch % SYNC_EVERY_BATCHES == 0:
-            # periodic dispatch barrier (no fetch): bounds how far the
-            # host can race ahead of the device, and so how many padded
-            # input buffers are pinned by in-flight executions
+            # periodic dispatch barrier (no fetch): hard backstop on
+            # how far the host can race ahead of the device beyond the
+            # pipeline window
             self._sync_device()
+
+    def _note_dispatch(self, token, nbytes):
+        """Pipeline bookkeeping for one dispatched batch: record
+        whether the upload overlapped still-running device work (the
+        previous batch's token not ready at dispatch time means the
+        device was busy while this batch staged + uploaded), then
+        bound the in-flight window by blocking on the token from
+        `depth` dispatches back."""
+        from .obs import metrics as obs_metrics
+        depth = pipeline_depth()
+        q = self._pipe
+        obs_metrics.inc('device_pipe_dispatches')
+        obs_metrics.set_gauge('device_pipeline_depth', depth)
+        if q and _acc_ready(q[-1]) is False:
+            obs_metrics.inc('device_pipe_overlapped')
+            obs_metrics.inc('device_h2d_overlapped_bytes', int(nbytes))
+        q.append(token)
+        jax = None
+        while len(q) > depth:
+            if jax is None:
+                jax, _ = get_jax()
+            jax.block_until_ready(q.popleft())
 
     # -- the device program -------------------------------------------------
 
@@ -1893,7 +2029,15 @@ class DeviceScan(VectorScan):
                     jnp.stack([nuniq, over]))
 
         if sparse_cap:
-            run_scatter = jax.jit(fold_sparse)
+            def run_sparse(args, acc):
+                out = fold_sparse(args, acc)
+                # completion token: a fresh scalar derived from the
+                # output.  Unlike the (donated) accumulator leaves it
+                # never re-enters the fold, so the pipeline can hold it
+                # and block on it after later batches have consumed the
+                # accumulator buffers (see _note_dispatch)
+                return out, jnp.sum(out[4]).astype(jnp.int32)
+            run_scatter = jax.jit(run_sparse, **_donate_kw())
 
             def fold_u(args, acc, use_pallas):
                 return fold_sparse(args, acc)
@@ -1915,10 +2059,17 @@ class DeviceScan(VectorScan):
                 _ACC_INIT_CACHE[init_key] = acc_init
             return _Programs(run_scatter, None, acc_init, fold_u)
 
-        run_scatter = jax.jit(lambda args, acc: fold(args, acc, False))
+        def _tokenized(up):
+            def run(args, acc):
+                out = fold(args, acc, up)
+                # fresh non-donated completion token (see run_sparse)
+                return out, jnp.sum(out[2]).astype(jnp.int32)
+            return run
+
+        run_scatter = jax.jit(_tokenized(False), **_donate_kw())
         run_pallas = None
         if pk.pallas_ok(ns) and pk.available():
-            run_pallas = jax.jit(lambda args, acc: fold(args, acc, True))
+            run_pallas = jax.jit(_tokenized(True), **_donate_kw())
 
         init_key = (acc_ns, ncnt)
         acc_init = _ACC_INIT_CACHE.get(init_key)
@@ -1964,6 +2115,7 @@ class DeviceScan(VectorScan):
         self._acc = None
         self._acc_meta = None
         self._acc_batch = 0
+        self._pipe.clear()   # the fetch below syncs the whole epoch
         # engine telemetry: batches folded on the device this epoch
         # (programmatic — Stage.counters / the cluster tests — but
         # kept out of the --counters dump for golden byte parity)
@@ -2123,6 +2275,22 @@ def _note_h2d(nbytes):
     if nbytes:
         from .obs import metrics as obs_metrics
         obs_metrics.inc('device_h2d_bytes', int(nbytes))
+
+
+def _upload_inputs(inputs):
+    """Issue async H2D transfers for the batch's host arrays, in
+    place, and return the uploaded byte count.  jax.device_put returns
+    immediately with the copy in flight, so by the time the jitted
+    fold is dispatched its operands are already on the wire — this is
+    what lets batch N+1's upload ride under batch N's execution
+    instead of serializing at dispatch."""
+    jax, _ = get_jax()
+    nbytes = 0
+    for k, v in list(inputs.items()):
+        if isinstance(v, np.ndarray) and v.ndim:
+            nbytes += int(v.nbytes)
+            inputs[k] = jax.device_put(v)
+    return nbytes
 
 
 def _fetch_arrays(arrays):
@@ -2368,19 +2536,32 @@ class DeviceScanStack(object):
         ckey = tuple(key_parts)
         run = _STACK_CACHE.get(ckey)
         if run is None:
-            jax, _ = get_jax()
+            jax, jnp = get_jax()
             folds = [p[0] for p in parts]
             ups = [p[1] for p in parts]
 
             def stacked(args, accs):
-                return tuple(f(args, a, u)
+                outs = tuple(f(args, a, u)
                              for f, a, u in zip(folds, accs, ups))
-            run = jax.jit(stacked)
+                # one fresh, non-donated completion token for the
+                # whole stacked batch (see DeviceScan._note_dispatch)
+                tok = jnp.int32(0)
+                for o in outs:
+                    tok = tok + jnp.sum(o[-1]).astype(jnp.int32)
+                return outs, tok
+            run = jax.jit(stacked, **_donate_kw())
             if len(_STACK_CACHE) >= 32:
                 _STACK_CACHE.pop(next(iter(_STACK_CACHE)))
             _STACK_CACHE[ckey] = run
 
-        accs = run(inputs, tuple(s._acc for s in scans))
+        if scans[0]._device_mesh() is None:
+            nbytes = _upload_inputs(inputs)
+        else:
+            nbytes = sum(int(getattr(v, 'nbytes', 0) or 0)
+                         for v in inputs.values()
+                         if isinstance(v, np.ndarray))
+        _note_h2d(nbytes)
+        accs, token = run(inputs, tuple(s._acc for s in scans))
         for s, acc in zip(scans, accs):
             s._acc = acc
             s._acc_batch += 1
@@ -2388,6 +2569,7 @@ class DeviceScanStack(object):
             # (kept out of --counters for golden byte parity)
             s.aggr.stage.bump_hidden('nstackedbatches', 1)
         self._nbatch += 1
+        scans[0]._note_dispatch(token, nbytes)
         if self._nbatch % SYNC_EVERY_BATCHES == 0:
             scans[0]._sync_device()
         return True

@@ -652,7 +652,14 @@ class VectorScan(object):
         num_segments = 1
         for r in radices:
             num_segments *= max(r, 1)
-        if num_segments > MAX_DENSE_SEGMENTS or 0 in radices:
+        if num_segments > MAX_DENSE_SEGMENTS or 0 in radices or \
+                (num_segments > max(65536, 4 * n)
+                 and engine_mode() != 'jax'):
+            # high-cardinality batch: the dense accumulator would touch
+            # O(num_segments) memory several times per batch (bincount +
+            # first-occurrence table) for a key space far larger than
+            # the batch itself — the sort-based merge is O(n log n) on
+            # the batch and emits the identical first-occurrence order
             self._sparse_merge(key_codes, decoders, weights, alive)
             return
 
@@ -777,6 +784,16 @@ class VectorScan(object):
                 gcols.append(np.asarray(dec, dtype=np.int64)[cc])
             else:
                 gcols.append(cc)
+        sink = getattr(self.aggr, 'write_columnar', None)
+        if sink is not None and len(idx) >= DEFER_UNIQUE:
+            # MT worker feeding a radix merge: skip the per-batch
+            # unique entirely — in a high-cardinality batch it barely
+            # shrinks the rows (that is what made it spill), so hand
+            # the raw rows over and dedup ONCE in the merge, whose
+            # first-occurrence compaction yields the identical order
+            sink(gcols, np.asarray(weights, dtype=np.float64)[idx],
+                 self._breakdown_cols)
+            return
         first_idx, inv, order = _unique_rows(gcols)
         wsum = np.bincount(inv, weights=weights[idx],
                            minlength=len(first_idx))
@@ -793,6 +810,15 @@ class VectorScan(object):
         crosses DEFER_UNIQUE tuples — appended to the deferred columnar
         buffer collapsed at finish, so high-cardinality scans do
         per-tuple Python work once per OUTPUT tuple, not per batch."""
+        sink = getattr(self.aggr, 'write_columnar', None)
+        if sink is not None and gcols and len(wvals) >= DEFER_UNIQUE:
+            # MT worker with a radix-merge sink: hand the raw code
+            # columns across the thread boundary instead of decoding
+            # per tuple; the worker's column objects ride along so the
+            # merger can translate string codes into the main
+            # scanner's dictionaries (scan_mt.RadixMerge)
+            sink(gcols, wvals, self._breakdown_cols)
+            return
         if self._defer is None and self._defer_enabled and gcols and \
                 len(wvals) >= DEFER_UNIQUE:
             self._defer = ([[] for _ in gcols], [])

@@ -137,11 +137,16 @@ def _iq_auto():
 
 
 def degrade_to_sequential(npaths, nworkers):
-    """Whether this fan-out should skip the pool: per-shard cost below
-    DN_IQ_SEQ_MS (default 2.0 ms; 'off' disables the heuristic), or
-    fewer than DN_IQ_MIN_PER_WORKER (default 4) shards per worker —
-    either way pool dispatch costs more than it overlaps.  Applies
-    only in auto mode."""
+    """Whether this fan-out should skip the pool on PRIOR evidence
+    alone: per-shard cost below DN_IQ_SEQ_MS (default 2.0 ms; 'off'
+    disables the heuristic), or fewer than DN_IQ_MIN_PER_WORKER
+    (default 4) shards per worker — either way pool dispatch costs
+    more than it overlaps.  Applies only in auto mode.  The fan-out
+    entry point consults this only until both strategies have a
+    measured whole-fan-out cost (_choose_fanout), because the
+    per-shard EMA is fed from inside pool workers where GIL convoying
+    inflates wall times — a busy pool can read 3-6x the true cost and
+    pin the estimate above the threshold forever."""
     if not _iq_auto():
         return False
     v = os.environ.get('DN_IQ_SEQ_MS', '2.0')
@@ -161,6 +166,90 @@ def degrade_to_sequential(npaths, nworkers):
     with _SEQ_EMA_LOCK:
         ema = _SEQ_EMA[0]
     return ema is not None and ema < threshold
+
+
+# -- measured fan-out strategy selection ----------------------------------
+
+# effective per-shard cost (ms, wall clock / nshards) of each complete
+# multi-shard fan-out, by strategy.  Unlike _SEQ_EMA (one shard's wall
+# time, convoy-inflated under the pool), this is the quantity the
+# caller actually waits for, so comparing the two EMAs picks the
+# strategy that is empirically faster ON THIS MACHINE for this
+# workload — the round-5 regression (pool 238.7 ms vs sequential
+# 218.6 ms over 365 shards) becomes a one-fan-out mistake instead of
+# a permanent tax.
+_FANOUT_LOCK = threading.Lock()
+_FANOUT_EMA = {'pool': None, 'seq': None}
+_FANOUT_STATE = {'n': 0, 'last_mode': None}
+
+# re-measure the losing strategy once per this many fan-outs, so a
+# verdict reached under transient load (or before the handle cache
+# warmed) is not frozen forever; costs at most one slower fan-out per
+# window
+_FANOUT_REEXPLORE = 100
+
+
+def _note_fanout(mode, ms_per_shard):
+    with _FANOUT_LOCK:
+        prev = _FANOUT_EMA[mode]
+        _FANOUT_EMA[mode] = ms_per_shard if prev is None \
+            else prev * 0.7 + ms_per_shard * 0.3
+        _FANOUT_STATE['last_mode'] = mode
+
+
+def fanout_stats():
+    """Measured per-shard fan-out costs + the last strategy used —
+    `dn serve` /stats and the bench artifact surface it so a degraded
+    pool is visible, not silent."""
+    with _FANOUT_LOCK:
+        return {'pool_ms_per_shard': _FANOUT_EMA['pool'],
+                'seq_ms_per_shard': _FANOUT_EMA['seq'],
+                'fanouts': _FANOUT_STATE['n'],
+                'last_mode': _FANOUT_STATE['last_mode']}
+
+
+def _fanout_reset():
+    with _FANOUT_LOCK:
+        _FANOUT_EMA['pool'] = _FANOUT_EMA['seq'] = None
+        _FANOUT_STATE['n'] = 0
+        _FANOUT_STATE['last_mode'] = None
+
+
+def _choose_fanout(npaths, nworkers):
+    """'pool' or 'seq' (the cached sequential loop) for a multi-shard
+    fan-out.  Explicit DN_IQ_THREADS overrides always pool; too few
+    shards per worker always degrades.  Otherwise: once both
+    strategies have a measured cost, take the empirical winner
+    (re-measuring the loser once per _FANOUT_REEXPLORE fan-outs);
+    until then fall back to the threshold prior
+    (degrade_to_sequential), measuring whichever side it picks so the
+    comparison completes itself."""
+    if nworkers <= 1:
+        # one worker cannot overlap anything; the pool is pure
+        # queue-handoff overhead over the same cached loop
+        return 'seq' if _iq_auto() else 'pool'
+    if not _iq_auto():
+        return 'pool'
+    try:
+        min_per = max(1, int(os.environ.get('DN_IQ_MIN_PER_WORKER',
+                                            '4')))
+    except ValueError:
+        min_per = 4
+    if npaths < nworkers * min_per:
+        return 'seq'
+    with _FANOUT_LOCK:
+        pool_ms = _FANOUT_EMA['pool']
+        seq_ms = _FANOUT_EMA['seq']
+        _FANOUT_STATE['n'] += 1
+        n = _FANOUT_STATE['n']
+    if pool_ms is not None and seq_ms is not None:
+        winner = 'pool' if pool_ms < seq_ms else 'seq'
+        if n % _FANOUT_REEXPLORE == 0:
+            return 'seq' if winner == 'pool' else 'pool'
+        return winner
+    if degrade_to_sequential(npaths, nworkers):
+        return 'seq'
+    return 'pool' if pool_ms is None else 'seq'
 
 
 # -- shard filename time ranges ------------------------------------------
@@ -511,6 +600,7 @@ def shard_cache_clear():
         _CACHE_STATS['misses'] = 0
     with _SEQ_EMA_LOCK:
         _SEQ_EMA[0] = None
+    _fanout_reset()
     with _FIND_LOCK:
         _FIND_CACHE.clear()
     for handle in handles:
@@ -867,18 +957,24 @@ def run_shard_queries(paths, query, nworkers, on_items):
     if nworkers <= 0:
         for path in paths:
             on_items(query_shard_once(path, query))
-    elif len(paths) == 0:
+        return
+    if len(paths) == 0:
         return                    # empty window: nothing to query
-    elif len(paths) == 1:
+    if len(paths) == 1:
         on_items(_query_shard_cached(paths[0], query))
-    elif degrade_to_sequential(len(paths),
-                               min(nworkers, len(paths))):
+        return
+    mode = _choose_fanout(len(paths), min(nworkers, len(paths)))
+    t0 = time.monotonic()
+    if mode == 'seq':
         counter_bump('index query pool degraded')
         for path in paths:
             on_items(_query_shard_cached(path, query))
     else:
         ex = ShardQueryExecutor(query, min(nworkers, len(paths)))
         ex.run(paths, on_items)
+    # note only completed fan-outs: a shard error above raises before
+    # this line, and a partial timing would poison the comparison
+    _note_fanout(mode, (time.monotonic() - t0) * 1000.0 / len(paths))
 
 
 def run_shard_loads(paths, query, on_blocks):

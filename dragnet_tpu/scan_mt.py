@@ -29,6 +29,8 @@ import os
 import queue
 import threading
 
+import numpy as np
+
 from .watchdog import LeakCheck
 
 # an executor that is never finish()ed means submitted batches may
@@ -46,6 +48,18 @@ def scan_threads():
         except ValueError:
             return 0
     return max(1, min(6, os.cpu_count() or 1))
+
+
+def scan_partitions():
+    """Radix partition count for the MT merge (DN_SCAN_PARTITIONS;
+    auto = up to 8, bounded by CPU count)."""
+    v = os.environ.get('DN_SCAN_PARTITIONS', 'auto')
+    if v != 'auto':
+        try:
+            return max(1, int(v))
+        except ValueError:
+            pass
+    return max(1, min(8, os.cpu_count() or 1))
 
 
 class PinnedList(object):
@@ -219,10 +233,290 @@ class BatchRecorder(object):
     def write_key(self, keys, value):
         self.calls.append((keys, value))
 
+    def write_columnar(self, gcols, wvals, bcols):
+        """Columnar emission from a worker's _emit_unique: raw global
+        code columns + dense weight sums, no per-tuple Python decode.
+        `bcols` is the worker scan's _breakdown_cols — the merger needs
+        the worker's column objects to translate string codes into the
+        main scanner's dictionaries.  keys=None marks the entry so the
+        replay can tell it from a decoded write_key call."""
+        self.calls.append((None, (gcols, wvals, bcols)))
+
     def drain(self):
         calls = self.calls
         self.calls = []
         return calls
+
+
+# -- radix-partitioned merge -------------------------------------------------
+
+# merge-phase telemetry accumulated across RadixMerge finalizations
+# (bench reads the scan/merge time split from here; reset per leg)
+_MERGE_STATS = {'merge_ms': 0.0, 'partitions': 0, 'rows': 0,
+                'unique': 0, 'engaged': 0}
+_MERGE_LOCK = threading.Lock()
+
+
+def reset_merge_stats():
+    with _MERGE_LOCK:
+        _MERGE_STATS.update(merge_ms=0.0, partitions=0, rows=0,
+                            unique=0, engaged=0)
+
+
+def merge_stats():
+    with _MERGE_LOCK:
+        return dict(_MERGE_STATS)
+
+
+_M1 = np.uint64(0xff51afd7ed558ccd)
+_M2 = np.uint64(0xc4ceb9fe1a85ec53)
+_S33 = np.uint64(33)
+
+
+def _mix64(x):
+    """splitmix64-style finalizer, vectorized (uint64 wraparound)."""
+    x = x ^ (x >> _S33)
+    x = x * _M1
+    x = x ^ (x >> _S33)
+    x = x * _M2
+    return x ^ (x >> _S33)
+
+
+def _hash_partition(cols, nparts):
+    """Deterministic partition id per row from its code tuple.  The
+    codes are MAIN-dictionary codes (translated before hashing), so a
+    given key tuple always lands in the same partition regardless of
+    which worker produced it."""
+    h = np.zeros(len(cols[0]), dtype=np.uint64)
+    for arr in cols:
+        h = _mix64(h ^ _mix64(arr.astype(np.uint64)))
+    return (h % np.uint64(nparts)).astype(np.int64)
+
+
+class RadixMerge(object):
+    """Radix-partitioned aggregation for the MT merger: replaces the
+    serial per-tuple write_key funnel for high-cardinality scans.
+
+    Workers emit raw (code columns, weight sums) per batch
+    (BatchRecorder.write_columnar); the merger thread translates worker
+    string codes into the main scanner's dictionaries (vectorized,
+    cached per worker column — the append-only-dictionary idiom of
+    engine._native_str_trans), hash-partitions the fused keys into P
+    disjoint partitions, and buffers rows per partition tagged with
+    their global arrival position.  finalize() compacts the partitions
+    in parallel (unique + weight bincount per partition — no
+    cross-partition contention), restores global first-occurrence
+    order by the recorded positions, and hands the scanner ONE columnar
+    emission.
+
+    Byte-identity with the serial merge: partition extraction is a
+    stable filter of the seq-ordered row stream, np.bincount folds
+    weights in array index order, and compaction partials land at
+    first-occurrence positions — every weight is a left-fold of the
+    same batch partials in the same global order the serial replay
+    added them, and the final argsort by arrival position reproduces
+    the global first-occurrence key order exactly.
+
+    Small batches (< engine.DEFER_UNIQUE uniques) stay on the decoded
+    write_key path until the first columnar batch engages the radix
+    buffer; after that every call routes through it so seq order is
+    preserved end to end."""
+
+    # compact a partition's buffer once it holds this many rows
+    # (memory stays bounded by unique tuples, engine._defer_compact's
+    # discipline applied per partition)
+    PART_COMPACT_ROWS = 1 << 20
+
+    def __init__(self, scanner, npartitions=None):
+        self.scanner = scanner
+        self.npartitions = int(npartitions or scan_partitions())
+        self.engaged = False
+        self.rows_in = 0
+        self.merge_ms = 0.0
+        self._gpos = 0
+        self._ncols = len(scanner._breakdown_cols)
+        self._parts = None
+
+    # -- merger-thread entry ------------------------------------------------
+
+    def apply_calls(self, calls):
+        """Replay one worker batch's recorded calls in order (runs on
+        the merger thread, batches arrive in seq order)."""
+        import time as mod_time
+        write_key = self.scanner.aggr.write_key
+        pend = None
+        for keys, payload in calls:
+            if keys is None:
+                if pend:
+                    self._add_key_batch(pend)
+                    pend = None
+                t0 = mod_time.perf_counter()
+                self._add_columnar(*payload)
+                self.merge_ms += (mod_time.perf_counter() - t0) * 1e3
+            elif not self.engaged:
+                write_key(keys, payload)
+            else:
+                if pend is None:
+                    pend = []
+                pend.append((keys, payload))
+        if pend:
+            self._add_key_batch(pend)
+
+    def _add_columnar(self, gcols, wvals, wbcols):
+        cols = []
+        for (kind, mcol), (_, wcol), arr in zip(
+                self.scanner._breakdown_cols, wbcols, gcols):
+            arr = np.asarray(arr, dtype=np.int64)
+            if kind == 'str':
+                arr = _translate_codes(wcol, mcol, arr)
+            cols.append(arr)
+        self._append(cols, np.asarray(wvals, dtype=np.float64))
+
+    def _add_key_batch(self, items):
+        """Decoded (keys, value) calls arriving after engagement: encode
+        into main-dictionary codes and append in seq order, so late
+        small batches keep their place in the global order."""
+        import time as mod_time
+        t0 = mod_time.perf_counter()
+        n = len(items)
+        cols = [np.empty(n, dtype=np.int64) for _ in range(self._ncols)]
+        w = np.empty(n, dtype=np.float64)
+        encoders = [(col.dict.code if kind == 'str' else None)
+                    for kind, col in self.scanner._breakdown_cols]
+        for i, (keys, v) in enumerate(items):
+            for ci, (enc, k) in enumerate(zip(encoders, keys)):
+                cols[ci][i] = enc(k, k) if enc is not None else k
+            w[i] = v
+        self._append(cols, w)
+        self.merge_ms += (mod_time.perf_counter() - t0) * 1e3
+
+    # -- partition buffers --------------------------------------------------
+
+    def _append(self, cols, w):
+        if not self.engaged:
+            self.engaged = True
+            self._parts = [([[] for _ in range(self._ncols)], [], [],
+                            [0]) for _ in range(self.npartitions)]
+        n = len(w)
+        pos = np.arange(self._gpos, self._gpos + n, dtype=np.int64)
+        self._gpos += n
+        self.rows_in += n
+        if self.npartitions <= 1:
+            self._append_part(0, cols, w, pos)
+            return
+        pid = _hash_partition(cols, self.npartitions)
+        for p in np.unique(pid):
+            m = pid == p
+            self._append_part(int(p), [c[m] for c in cols], w[m],
+                              pos[m])
+
+    def _append_part(self, p, cols, w, pos):
+        ccols, cw, cpos, nrows = self._parts[p]
+        for lst, arr in zip(ccols, cols):
+            lst.append(arr)
+        cw.append(w)
+        cpos.append(pos)
+        nrows[0] += len(w)
+        if nrows[0] > self.PART_COMPACT_ROWS:
+            self._parts[p] = self._compact_part(self._parts[p])
+
+    def _compact_part(self, part):
+        """Unique + weight-sum one partition's buffered rows,
+        first-occurrence order (ascending buffer index == ascending
+        global position) preserved — engine._defer_compact per
+        partition, with the arrival positions riding along."""
+        from .engine import _unique_rows
+        ccols, cw, cpos, nrows = part
+        gcols = [c[0] if len(c) == 1 else np.concatenate(c)
+                 for c in ccols]
+        w = cw[0] if len(cw) == 1 else np.concatenate(cw)
+        pos = cpos[0] if len(cpos) == 1 else np.concatenate(cpos)
+        first_idx, inv, order = _unique_rows(gcols)
+        wsum = np.bincount(inv, weights=w, minlength=len(first_idx))
+        rows = first_idx[order]
+        return ([[arr[rows]] for arr in gcols], [wsum[order]],
+                [pos[rows]], [len(rows)])
+
+    # -- finalization -------------------------------------------------------
+
+    def finalize(self):
+        """Compact every partition (in parallel — numpy's sorts release
+        the GIL), stitch the partitions back into global
+        first-occurrence order, and emit once into the main scanner."""
+        import time as mod_time
+        from .obs import metrics as obs_metrics
+        if not self.engaged:
+            return
+        t0 = mod_time.perf_counter()
+        parts = self._parts
+        self._parts = None
+        live = [p for p in range(self.npartitions) if parts[p][3][0]]
+        results = [None] * self.npartitions
+        errors = []
+
+        def work(p):
+            try:
+                results[p] = self._compact_part(parts[p])
+            except BaseException as e:
+                errors.append(e)
+
+        if len(live) > 1:
+            threads = [threading.Thread(target=work, args=(p,))
+                       for p in live[1:]]
+            for t in threads:
+                t.start()
+            work(live[0])
+            for t in threads:
+                t.join()
+        elif live:
+            work(live[0])
+        if errors:
+            raise errors[0]
+        merged = [results[p] for p in live]
+        nuniq = 0
+        if merged:
+            cols = [np.concatenate([r[0][i][0] for r in merged])
+                    for i in range(self._ncols)]
+            w = np.concatenate([r[1][0] for r in merged])
+            pos = np.concatenate([r[2][0] for r in merged])
+            order = np.argsort(pos, kind='stable')
+            nuniq = len(w)
+            self.scanner._emit_unique([c[order] for c in cols],
+                                      w[order])
+        self.engaged = False
+        ms = (mod_time.perf_counter() - t0) * 1e3 + self.merge_ms
+        with _MERGE_LOCK:
+            _MERGE_STATS['merge_ms'] += ms
+            _MERGE_STATS['partitions'] = self.npartitions
+            _MERGE_STATS['rows'] += self.rows_in
+            _MERGE_STATS['unique'] += nuniq
+            _MERGE_STATS['engaged'] += 1
+            obs_metrics.set_gauge('scan_merge_partitions',
+                                  self.npartitions)
+            obs_metrics.set_gauge('scan_merge_ms',
+                                  _MERGE_STATS['merge_ms'])
+
+
+def _translate_codes(wcol, mcol, codes):
+    """Worker-dictionary string codes -> main-dictionary codes, via an
+    incremental translation array cached on the worker column (both
+    dictionaries are append-only; merger-thread only).  Worker threads
+    may append to wcol's dictionary concurrently, but list appends are
+    atomic and codes in a delivered batch only reference entries that
+    existed when the batch was produced."""
+    cached = getattr(wcol, '_radix_trans', None)
+    if cached is None or cached[0] is not mcol:
+        cached = (mcol, np.zeros(0, dtype=np.int64))
+    trans = cached[1]
+    values = wcol.dict.values
+    hi = len(values)
+    if hi > len(trans):
+        code = mcol.dict.code
+        new = np.array([code(s, s) for s in values[len(trans):hi]],
+                       dtype=np.int64)
+        trans = np.concatenate([trans, new]) if len(trans) else new
+        wcol._radix_trans = (mcol, trans)
+    return trans[codes]
 
 
 class MTScanExecutor(object):
@@ -238,7 +532,7 @@ class MTScanExecutor(object):
     QUEUE_DEPTH = 4
 
     def __init__(self, nworkers, build_worker, apply_result,
-                 main_pipeline, stage_offset):
+                 main_pipeline, stage_offset, finish_fn=None):
         import time as mod_time
         from .vpipe import Pipeline
         self.closed = False
@@ -246,6 +540,7 @@ class MTScanExecutor(object):
         _EXECUTOR_LEAKS.track(self)
         self.nworkers = nworkers
         self.apply_result = apply_result
+        self.finish_fn = finish_fn
         self.main_pipeline = main_pipeline
         self.stage_offset = stage_offset
         self.workq = queue.Queue(maxsize=self.QUEUE_DEPTH + nworkers)
@@ -352,6 +647,12 @@ class MTScanExecutor(object):
             nworkers=self.nworkers, batches=self.seq)
         if self.errors:
             raise self.errors[0]
+        if self.finish_fn is not None:
+            # drain any merge-side buffers (the radix merge) into the
+            # main scanner BEFORE the caller proceeds — a device
+            # takeover right after finish() must observe every batch
+            # this executor owned, in order
+            self.finish_fn()
         main_stages = self.main_pipeline.stages[self.stage_offset:]
         for wp in self.worker_pipelines:
             assert len(wp.stages) <= len(main_stages)

@@ -96,6 +96,22 @@ def content_key(kind, arrays, shape):
     return (kind, shape, h.hexdigest())
 
 
+def _device_deleted(x):
+    """True when a pinned device reference no longer owns its buffer.
+    The pipelined scan fold donates accumulator arguments, and a
+    donated jax.Array reports is_deleted() — a pin that aliased one
+    would hold no HBM and must read as a miss, never as residency."""
+    if isinstance(x, (tuple, list)):
+        return any(_device_deleted(v) for v in x)
+    fn = getattr(x, 'is_deleted', None)
+    if callable(fn):
+        try:
+            return bool(fn())
+        except Exception:
+            return False
+    return False
+
+
 class DeviceResidency(object):
     """LRU of device-resident accumulators, bounded by HBM bytes,
     invalidated by the writer epoch.  Thread-safe — the serve workers
@@ -144,6 +160,10 @@ class DeviceResidency(object):
         with self._lock:
             ent = self._entries.get(key)
             if ent is not None and ent['epoch'] != epoch:
+                self._drop_locked(key, ent)
+                self._stale += 1
+                ent = None
+            if ent is not None and _device_deleted(ent['device']):
                 self._drop_locked(key, ent)
                 self._stale += 1
                 ent = None

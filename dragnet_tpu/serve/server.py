@@ -890,6 +890,35 @@ class DnServer(object):
                 obs_history.GAUGE_KIND, fs.get('ingest_lag_ms'))
         return out
 
+    def _pipeline_doc(self):
+        """Device pipelined-dispatch gauges, read back from the typed
+        registry the scan path writes (device_scan._note_dispatch):
+        the same numbers Prometheus exposes, shaped for /stats."""
+        from .. import device_scan as mod_ds
+        reg = obs_metrics.global_registry()
+        h2d = reg.counter('device_h2d_bytes').value
+        ov = reg.counter('device_h2d_overlapped_bytes').value
+        return {
+            'depth': mod_ds.pipeline_depth(),
+            'dispatches': reg.counter('device_pipe_dispatches').value,
+            'overlapped': reg.counter('device_pipe_overlapped').value,
+            'h2d_bytes': h2d,
+            'h2d_overlapped_bytes': ov,
+            'overlap_ratio': round(ov / h2d, 4) if h2d else 0.0,
+            'batch_floor': int(reg.gauge('device_batch_floor').value),
+        }
+
+    def _scan_merge_doc(self):
+        from .. import scan_mt as mod_scan_mt
+        ms = mod_scan_mt.merge_stats()
+        return {
+            'partitions': mod_scan_mt.scan_partitions(),
+            'merge_ms': round(ms['merge_ms'], 3),
+            'merges': ms['engaged'],
+            'rows_in': ms['rows'],
+            'unique_rows': ms['unique'],
+        }
+
     def stats_doc(self):
         counters = mod_vpipe.global_counters()
         with self._stats_lock:
@@ -917,6 +946,9 @@ class DnServer(object):
                 'shard_handles': mod_iqmt.shard_cache_stats(),
                 'find_memo': mod_iqmt.find_cache_stats(),
                 'results': self.qcache.stats(),
+                # measured pool-vs-sequential fan-out costs and the
+                # strategy the last multi-shard query actually ran
+                'index_fanout': mod_iqmt.fanout_stats(),
             },
             'counters': counters,
             'device': {
@@ -928,7 +960,15 @@ class DnServer(object):
                 # background thread reports (or when gated off)
                 'residency': mod_residency.stats(),
                 'prewarm': self._prewarm_doc,
+                # pipelined-dispatch telemetry (device_scan): window
+                # depth, dispatch/overlap counters, and how much of
+                # the H2D upload volume rode under compute
+                'pipeline': self._pipeline_doc(),
             },
+            # radix-partitioned MT merge telemetry (scan_mt): the
+            # configured partition count and the accumulated
+            # merge-phase cost since process start
+            'scan_merge': self._scan_merge_doc(),
             # resource governance (resources.py): mode, per-tree
             # disk view, fd headroom, memory-budget accounting,
             # transition counters

@@ -427,3 +427,35 @@ def test_follow_config_rejects_bad_values():
         err = mod_config.follow_config(env=env)
         assert isinstance(err, DNError), env
         assert str(err).startswith(list(env)[0]), env
+
+
+def test_device_config_defaults():
+    conf = mod_config.device_config(env={})
+    assert conf == {'residency_mb': 0, 'prewarm': True,
+                    'probe_timeout_s': 420, 'audition_ttl_s': 86400,
+                    'pipeline_depth': 2, 'batch_floor': 0,
+                    'scan_partitions': 'auto'}
+
+
+def test_device_config_parses_overrides():
+    conf = mod_config.device_config(env={
+        'DN_DEVICE_PIPELINE_DEPTH': '4',
+        'DN_DEVICE_BATCH_FLOOR': '8192',
+        'DN_SCAN_PARTITIONS': '16'})
+    assert conf['pipeline_depth'] == 4
+    assert conf['batch_floor'] == 8192
+    assert conf['scan_partitions'] == 16
+    assert mod_config.device_config(
+        env={'DN_SCAN_PARTITIONS': 'auto'})['scan_partitions'] == \
+        'auto'
+
+
+def test_device_config_rejects_bad_values():
+    for env in ({'DN_DEVICE_PIPELINE_DEPTH': '0'},
+                {'DN_DEVICE_PIPELINE_DEPTH': 'deep'},
+                {'DN_DEVICE_BATCH_FLOOR': '-1'},
+                {'DN_SCAN_PARTITIONS': '0'},
+                {'DN_SCAN_PARTITIONS': 'some'}):
+        err = mod_config.device_config(env=env)
+        assert isinstance(err, DNError), env
+        assert str(err).startswith(list(env)[0]), env

@@ -250,3 +250,121 @@ def test_fuzz_stacked_build_matches_host(tmp_path, monkeypatch, seed):
     assert host_tree.keys() == dev_tree.keys()
     for rel in host_tree:
         assert host_tree[rel] == dev_tree[rel], (seed, rel)
+
+
+# -- radix-partition sweeps --------------------------------------------------
+#
+# The MT merge funnel routes worker batches into DN_SCAN_PARTITIONS
+# hash partitions and compacts each once at finalize (scan_mt.
+# RadixMerge); its contract is byte-identity with the single-threaded
+# merge at ANY partition count.  Sweep degenerate (P=1), prime (P=7,
+# no power-of-two hash alignment), and sparse (P=64 over few hundred
+# rows: most partitions empty) counts, with the engine thresholds
+# forced tiny so the sparse-overflow reroute, the raw (non-uniqued)
+# batch hand-off, and the mid-merge overflow compaction all engage.
+
+def _tiny_merge_thresholds(monkeypatch):
+    from dragnet_tpu import engine as mod_engine
+    from dragnet_tpu import scan_mt as mod_scan_mt
+    monkeypatch.setattr(mod_engine, 'MAX_DENSE_SEGMENTS', 32)
+    monkeypatch.setattr(mod_engine, 'BATCH_SIZE', 96)
+    # raw hand-off at tiny batches (production gate: 4096 uniques)
+    monkeypatch.setattr(mod_engine, 'DEFER_UNIQUE', 8)
+    # force the sparse-overflow boundary: partitions compact mid-scan
+    # whenever buffered rows cross 64, then again at finalize
+    monkeypatch.setattr(mod_scan_mt.RadixMerge, 'PART_COMPACT_ROWS',
+                        64)
+
+
+@pytest.mark.parametrize('npart', [1, 2, 7, 64])
+@pytest.mark.parametrize('seed', [31, 32])
+def test_fuzz_partition_sweep_scan(tmp_path, monkeypatch, seed, npart):
+    """Partitioned MT scan vs the single-threaded merge: identical
+    points and visible counters for every partition count."""
+    _tiny_merge_thresholds(monkeypatch)
+    rng = random.Random(seed)
+    datafile = str(tmp_path / 'fuzz.log')
+    with open(datafile, 'w') as f:
+        for i in range(700):
+            f.write(json.dumps(_rand_record(rng),
+                               separators=(',', ':')) + '\n')
+
+    def scan(threads):
+        monkeypatch.setenv('DN_ENGINE', 'vector')
+        monkeypatch.setenv('DN_SCAN_THREADS', threads)
+        monkeypatch.setenv('DN_SCAN_PARTITIONS', str(npart))
+        ds = DatasourceFile({
+            'ds_backend': 'file',
+            'ds_backend_config': {'path': datafile,
+                                  'timeField': 'time'},
+            'ds_filter': None, 'ds_format': 'json',
+        })
+        r = ds.scan(mod_query.query_load(
+            {'breakdowns': [{'name': 'host'}, {'name': 'latency'}]}))
+        counters = {(s.name, k): v for s in r.pipeline.stages
+                    for k, v in s.counters.items()
+                    if v and k not in s.hidden}
+        return r.points, counters
+
+    sp, sc = scan('0')
+    pp, pc = scan('3')
+    assert sp == pp, (seed, npart)
+    assert sc == pc, (seed, npart)
+
+
+@pytest.mark.parametrize('fmt', ['dnc', 'sqlite'])
+@pytest.mark.parametrize('interval', ['hour', 'day'])
+def test_fuzz_partition_sweep_build(tmp_path, monkeypatch, fmt,
+                                    interval):
+    """Partition-count sweep through the BUILD path: the index trees
+    (every shard's bytes, both formats, hour and day granularity) must
+    be byte-identical to the single-threaded merge's at P=1,2,7,64."""
+    _tiny_merge_thresholds(monkeypatch)
+    monkeypatch.setenv('DN_INDEX_FORMAT', fmt)
+    monkeypatch.setenv('DN_ENGINE', 'vector')
+    monkeypatch.setenv('DN_PARSE_THREADS', '1')
+    rng = random.Random(41)
+    datafile = str(tmp_path / 'fuzz.log')
+    with open(datafile, 'w') as f:
+        for i in range(500):
+            rec = _rand_record(rng)
+            if rng.random() < 0.8:
+                rec['time'] = '2014-05-01T%02d:%02d:00Z' % (
+                    rng.randrange(24), rng.randrange(60))
+            f.write(json.dumps(rec, separators=(',', ':')) + '\n')
+
+    metrics = [mod_query.metric_deserialize(m) for m in [
+        {'name': 'a', 'breakdowns': [
+            {'name': 'timestamp', 'field': 'time', 'date': '',
+             'aggr': 'lquantize', 'step': 3600},
+            {'name': 'host', 'field': 'host'},
+            {'name': 'latency', 'field': 'latency',
+             'aggr': 'quantize'}]},
+    ]]
+
+    def build(threads, npart, sub):
+        monkeypatch.setenv('DN_SCAN_THREADS', threads)
+        monkeypatch.setenv('DN_SCAN_PARTITIONS', str(npart))
+        idx = str(tmp_path / sub)
+        ds = DatasourceFile({
+            'ds_backend': 'file',
+            'ds_backend_config': {'path': datafile, 'indexPath': idx,
+                                  'timeField': 'time'},
+            'ds_filter': None, 'ds_format': 'json',
+        })
+        ds.build(metrics, interval)
+        out = {}
+        for root, dirs, files in os.walk(idx):
+            for fn in sorted(files):
+                p = os.path.join(root, fn)
+                with open(p, 'rb') as f:
+                    out[os.path.relpath(p, idx)] = f.read()
+        return out
+
+    base = build('0', 1, 'i_seq')
+    assert base, 'baseline build produced no shards'
+    for npart in (1, 2, 7, 64):
+        tree = build('3', npart, 'i_p%d' % npart)
+        assert tree.keys() == base.keys(), (fmt, interval, npart)
+        for rel in base:
+            assert tree[rel] == base[rel], (fmt, interval, npart, rel)

@@ -464,6 +464,49 @@ def test_degrade_crossover(monkeypatch):
         mod_iqmt._seq_ema_set(None)
 
 
+def test_choose_fanout_measured_winner(monkeypatch):
+    """Once both fan-out strategies have a measured whole-fan-out
+    cost, the empirical winner is chosen regardless of the per-shard
+    EMA prior (which pool-worker GIL convoying can inflate); until
+    then the threshold prior routes, and each side gets measured."""
+    for k in ('DN_IQ_THREADS', 'DN_QUERY_CONCURRENCY',
+              'DN_IQ_SEQ_MS', 'DN_IQ_MIN_PER_WORKER'):
+        monkeypatch.delenv(k, raising=False)
+    try:
+        mod_iqmt._fanout_reset()
+        mod_iqmt._seq_ema_set(None)
+        # nothing measured, EMA prior silent: pool explores first
+        assert mod_iqmt._choose_fanout(365, 4) == 'pool'
+        # pool measured, seq not: measure the other side
+        mod_iqmt._note_fanout('pool', 0.65)
+        assert mod_iqmt._choose_fanout(365, 4) == 'seq'
+        # both measured: empirical winner, even though the convoy-
+        # inflated per-shard EMA (3 ms > DN_IQ_SEQ_MS) says pool
+        mod_iqmt._note_fanout('seq', 0.40)
+        mod_iqmt._seq_ema_set(3.0)
+        assert mod_iqmt._choose_fanout(365, 4) == 'seq'
+        # ... and the other way around when the pool wins
+        mod_iqmt._note_fanout('pool', 0.20)
+        mod_iqmt._note_fanout('pool', 0.20)
+        mod_iqmt._note_fanout('pool', 0.20)
+        mod_iqmt._note_fanout('pool', 0.20)
+        assert mod_iqmt._choose_fanout(365, 4) == 'pool'
+        # one worker can overlap nothing: always the cached loop
+        assert mod_iqmt._choose_fanout(365, 1) == 'seq'
+        # tiny fan-out degrades regardless of measurements
+        assert mod_iqmt._choose_fanout(7, 4) == 'seq'
+        # explicit operator pool size is always honored
+        monkeypatch.setenv('DN_IQ_THREADS', '3')
+        assert mod_iqmt._choose_fanout(365, 3) == 'pool'
+        assert mod_iqmt._choose_fanout(365, 1) == 'pool'
+        st = mod_iqmt.fanout_stats()
+        assert st['pool_ms_per_shard'] is not None
+        assert st['last_mode'] == 'pool'
+    finally:
+        mod_iqmt._fanout_reset()
+        mod_iqmt._seq_ema_set(None)
+
+
 # -- serve integration: cached repeats + invalidation on write -------------
 
 @pytest.fixture

@@ -811,6 +811,11 @@ def test_serve_validate_ok(monkeypatch):
     monkeypatch.setenv('JAX_PLATFORMS', 'cpu')
     monkeypatch.delenv('DN_ENGINE', raising=False)
     monkeypatch.setenv('DN_AUDITION_CACHE', '0')
+    # pin the scan-pipeline line (auto values are machine-dependent)
+    monkeypatch.setenv('DN_SCAN_PARTITIONS', '4')
+    monkeypatch.setenv('DN_SCAN_THREADS', '2')
+    monkeypatch.delenv('DN_DEVICE_PIPELINE_DEPTH', raising=False)
+    monkeypatch.delenv('DN_DEVICE_BATCH_FLOOR', raising=False)
     rc, out, err = run_cli(['serve', '--validate', '--socket',
                             '/tmp/never-bound.sock'])
     assert rc == 0
@@ -842,7 +847,9 @@ def test_serve_validate_ok(monkeypatch):
                    b'events_file_max_mb=64\n'
                    b'device lane ok: engine=auto backend=host-only '
                    b'residency_mb=0 prewarm=1 probe_timeout_s=420 '
-                   b'audition_cache=off entries=0 wins=0\n')
+                   b'audition_cache=off entries=0 wins=0\n'
+                   b'scan pipeline ok: pipeline_depth=2 '
+                   b'batch_floor=auto partitions=4 scan_threads=2\n')
 
 
 def test_serve_validate_reports_armed_faults(monkeypatch):
