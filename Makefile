@@ -4,7 +4,8 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test check bench bench-iq bench-build bench-parse \
+.PHONY: all native test check bench bench-iq bench-iq-device \
+    bench-build bench-parse \
     bench-serve bench-cluster bench-follow bench-fanin bench-verify \
     soak-faults soak-cluster soak-follow soak-compact \
     soak-overload soak-rebalance soak-scrub soak-resources \
@@ -34,6 +35,12 @@ bench: native
 # sequential, pruning, shard-handle cache)
 bench-iq: native
 	$(PYTHON) bench.py --iq-only
+
+# the device index-query legs only: 365-shard year query host vs
+# forced device lane (DN_INDEX_DEVICE=1, byte identity asserted) plus
+# the residency repeat legs (accumulator pin, pinned shard tensors)
+bench-iq-device: native
+	$(PYTHON) bench.py --iq-device-only
 
 # the build-path legs only: 365-shard index write (columnar blocks,
 # sequential vs DN_BUILD_THREADS shard writer pool)

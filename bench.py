@@ -457,6 +457,191 @@ def index_query_bench(tmpdir):
     }
 
 
+def index_query_device_bench(tmpdir, probe_doc=None, runs=None):
+    """Device-offloaded index query (device_index): the 365-shard year
+    query host vs forced-device (DN_INDEX_DEVICE=1), byte identity
+    asserted, then residency legs — the exact-repeat accumulator pin
+    (zero transfer) and the pinned-shard repeat path (host pins
+    churned, staged shard tensors served from HBM, measured skipped
+    H2D bytes).  A device leg that cannot engage records the probe's
+    skip attribution, never a bare null."""
+    import shutil
+    from dragnet_tpu import device_index as mod_di
+    from dragnet_tpu import index_query_mt as mod_iqmt
+    datafile = os.path.join(tmpdir, 'iqdev.log')
+    idx = os.path.join(tmpdir, 'iqdev.idx')
+    n = int(os.environ.get('DN_BENCH_IQ_DEVICE_RECORDS', '600000'))
+    start_ms = 1388534400000             # 2014-01-01, 365 daily shards
+    gen_to_file(n, datafile, mindate_ms=start_ms,
+                maxdate_ms=start_ms + 365 * 86400000)
+    ds = make_ds(datafile, idx)
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    ds.build(metrics, 'day')
+    nshards = _count_shards(idx)
+    conf = {'breakdowns': [{'name': 'host'},
+                           {'name': 'latency', 'aggr': 'quantize'}],
+            'filter': {'eq': ['req.method', 'GET']}}
+
+    def q():
+        return mod_query.query_load(dict(conf))
+
+    def measure(reps, leg, before_rep=None):
+        times = []
+        for _ in range(reps):
+            if before_rep is not None:
+                before_rep()
+            t0 = time.monotonic()
+            ds.query(q(), 'day')
+            ms = (time.monotonic() - t0) * 1000
+            times.append(ms)
+            if runs is not None:
+                runs.add(leg, ms)
+        times.sort()
+        return (times[len(times) // 2],
+                times[min(len(times) - 1, int(len(times) * 0.95))])
+
+    def iqd_env(v):
+        prior = os.environ.get('DN_INDEX_DEVICE')
+        if v is None:
+            os.environ.pop('DN_INDEX_DEVICE', None)
+        else:
+            os.environ['DN_INDEX_DEVICE'] = v
+        return prior
+
+    out = {'index_query_device_shards': nshards}
+    prior_legacy = os.environ.pop('DN_QUERY_CONCURRENCY', None)
+    prior_mode = iqd_env('0')
+    try:
+        # host leg: the stacked path with the device lane pinned off
+        mod_iqmt.shard_cache_clear()
+        ds.query(q(), 'day')                 # warm handle cache
+        host_p50, host_p95 = measure(9, 'iq_device_host')
+        host_points = ds.query(q(), 'day').points
+        out['index_query_host_p50_ms'] = round(host_p50, 2)
+        out['index_query_host_p95_ms'] = round(host_p95, 2)
+
+        # forced-device leg (DN_INDEX_DEVICE=1): engagement measured
+        # from the lane's own counters, identity asserted byte-for-
+        # byte against the host points (canonical order included)
+        allow = probe_doc is None or probe_doc.get('alive', True)
+        engaged = False
+        if allow:
+            iqd_env('1')
+            mod_di._reset_engagement()
+            ds.query(q(), 'day')             # warm (jit compiles here)
+            dev_points = ds.query(q(), 'day').points
+            assert dev_points == host_points, \
+                'device index-query points diverge from host'
+            out['index_query_device_byte_identical'] = True
+            mod_di._reset_engagement()
+            dev_p50, dev_p95 = measure(9, 'iq_device_forced')
+            eng = mod_di.stats_doc()
+            engaged = eng['dispatches'] > 0
+            if engaged:
+                out['index_query_device_p50_ms'] = round(dev_p50, 2)
+                out['index_query_device_p95_ms'] = round(dev_p95, 2)
+                out['index_query_device_vs_host'] = \
+                    round(host_p50 / dev_p50, 3) if dev_p50 else None
+                out['index_device_dispatches'] = eng['dispatches']
+                out['index_device_shards_per_dispatch'] = \
+                    eng['shards_per_dispatch']
+                out['index_device_rows'] = eng['rows']
+        out['index_query_device_engaged'] = engaged
+        if not engaged:
+            # attribution, not a bare null: why the leg is absent
+            skip = {'reason': (probe_doc or {}).get('reason')
+                    or 'device lane did not engage '
+                    '(backend unavailable or exactness gate)'}
+            if probe_doc is not None:
+                skip['probe_duration_s'] = probe_doc.get('duration_s')
+            out['index_query_device_skip'] = skip
+
+        # residency legs: arm the serve residency manager in-process
+        # and measure (a) the exact-repeat accumulator pin and (b) the
+        # pinned-shard repeat path — host pins churned between reps
+        # (drop_host_pins, the state distinct-query traffic converges
+        # to), staged shard tensors answering from HBM
+        if engaged:
+            from dragnet_tpu.serve import residency as mod_residency
+            mgr = mod_residency.configure(256 << 20)
+            try:
+                mod_di._reset_engagement()
+                ds.query(q(), 'day')         # populate the pins
+                base = mod_di.stats_doc()['dispatches']
+                ds.query(q(), 'day')         # exact repeat: acc pin
+                out['index_device_acc_repeat_zero_dispatch'] = \
+                    mod_di.stats_doc()['dispatches'] == base
+                out['index_device_acc_d2h_saved_bytes'] = \
+                    mgr.stats()['d2h_saved_bytes']
+                mod_di._reset_engagement()
+                res_p50, res_p95 = measure(
+                    9, 'iq_device_resident',
+                    before_rep=mgr.drop_host_pins)
+                eng = mod_di.stats_doc()
+                hit_rate = eng['pinned_shard_hits'] / eng['shards'] \
+                    if eng['shards'] else 0.0
+                out['index_device_resident_p50_ms'] = round(res_p50, 2)
+                out['index_device_resident_p95_ms'] = round(res_p95, 2)
+                out['index_device_pinned_shard_hits'] = \
+                    eng['pinned_shard_hits']
+                out['index_device_pinned_shard_hit_rate'] = \
+                    round(hit_rate, 4)
+                out['index_device_h2d_saved_bytes'] = \
+                    eng['h2d_saved_bytes']
+                out['index_device_h2d_bytes'] = eng['h2d_bytes']
+            finally:
+                mod_residency.deconfigure()
+    finally:
+        iqd_env(prior_mode)
+        if prior_legacy is not None:
+            os.environ['DN_QUERY_CONCURRENCY'] = prior_legacy
+    mod_iqmt.shard_cache_clear()
+    shutil.rmtree(idx, ignore_errors=True)
+    os.unlink(datafile)
+    return out
+
+
+def main_iq_device():
+    """Device index-query legs only (`make bench-iq-device` /
+    --iq-device-only)."""
+    import shutil
+    import tempfile
+    probe_doc = device_probe()
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_iqdev_')
+    try:
+        iqd = index_query_device_bench(tmpdir, probe_doc=probe_doc)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    def fmt(v):
+        return ('%.1f' % v) if v is not None else 'n/a'
+    sys.stderr.write(
+        'bench-iq-device: %d shards; host p50 %sms device p50 %sms '
+        '(%sx); dispatches %s (%s shards/dispatch); resident p50 %sms '
+        'pinned hits %s (rate %s) h2d saved %s bytes; engaged=%s\n'
+        % (iqd['index_query_device_shards'],
+           fmt(iqd.get('index_query_host_p50_ms')),
+           fmt(iqd.get('index_query_device_p50_ms')),
+           fmt(iqd.get('index_query_device_vs_host')),
+           iqd.get('index_device_dispatches', 'n/a'),
+           iqd.get('index_device_shards_per_dispatch', 'n/a'),
+           fmt(iqd.get('index_device_resident_p50_ms')),
+           iqd.get('index_device_pinned_shard_hits', 'n/a'),
+           iqd.get('index_device_pinned_shard_hit_rate', 'n/a'),
+           iqd.get('index_device_h2d_saved_bytes', 'n/a'),
+           iqd['index_query_device_engaged']))
+    if not iqd['index_query_device_engaged']:
+        sys.stderr.write('bench-iq-device: skip attribution: %s\n'
+                         % iqd.get('index_query_device_skip'))
+    print(json.dumps({
+        'metric': 'index_query_device_p50_ms',
+        'value': iqd.get('index_query_device_p50_ms'),
+        'unit': 'ms',
+        'vs_baseline': iqd.get('index_query_device_vs_host'),
+        'extra': iqd,
+    }))
+
+
 def index_build_bench(tmpdir):
     """Build-focused legs (`make bench-build` / --build-only): the
     write side of the 365-shard daily tree index_query_bench reads.
@@ -2032,6 +2217,9 @@ def main():
     if '--iq-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'iq':
         return main_iq()
+    if '--iq-device-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'iq-device':
+        return main_iq_device()
     if '--build-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'build':
         return main_build()
@@ -2163,6 +2351,8 @@ def main():
         build_dev, build_stacked = None, 0
 
     iq = index_query_bench(tmpdir)
+    iqd = index_query_device_bench(tmpdir, probe_doc=probe_doc,
+                                   runs=runs)
     pb = parse_bench_extras(largefile, large_n, use_device)
     if use_device:
         kb = kernel_bench_extras(largefile)
@@ -2251,7 +2441,7 @@ def main():
         extra['device_leg_skips'] = {
             leg: dict(skip) for leg in
             ('scan_large_device', 'highcard_device', 'build_device',
-             'kernel_bench')}
+             'kernel_bench', 'index_query_device')}
     # the persisted audition cache the auto router escalates from —
     # lets a driver correlate "auto reached the device lane" with the
     # verdicts that were on disk when the run started
@@ -2276,6 +2466,7 @@ def main():
     if device_sub is not None:
         extra['device_subprocess_runs'] = device_sub.get('runs')
     extra.update(iq)
+    extra.update(iqd)
     extra.update(pb)
     extra.update(kb)
     extra.update(scale)

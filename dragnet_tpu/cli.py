@@ -1598,6 +1598,9 @@ def cmd_serve(ctx, argv):
     dev_conf = mod_config.device_config()
     if isinstance(dev_conf, DNError):
         fatal(dev_conf)
+    iq_conf = mod_config.index_device_config()
+    if isinstance(iq_conf, DNError):
+        fatal(iq_conf)
 
     cluster = opts.cluster or os.environ.get('DN_SERVE_TOPOLOGY') \
         or None
@@ -1725,6 +1728,11 @@ def cmd_serve(ctx, argv):
                1 if dev_conf['prewarm'] else 0,
                dev_conf['probe_timeout_s'], apath or 'off',
                entries, wins))
+        sys.stdout.write(
+            'index device lane ok: mode=%s batch_rows=%d '
+            'residency_share=%.2f\n'
+            % (iq_conf['mode'], iq_conf['batch_rows'],
+               iq_conf['residency_share']))
         from . import scan_mt as mod_scan_mt
         sys.stdout.write(
             'scan pipeline ok: pipeline_depth=%d batch_floor=%s '

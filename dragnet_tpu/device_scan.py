@@ -2293,18 +2293,97 @@ def _upload_inputs(inputs):
     return nbytes
 
 
-def _fetch_arrays(arrays):
-    """np.asarray over several device arrays; DN_PARALLEL_FETCH=1
-    fetches them on a small thread pool (measured ~40% faster over the
-    tunnel, but concurrent transfers can deadlock some device plugins,
-    so sequential is the safe default)."""
+_PARALLEL_FETCH = {
+    'enabled': None,    # None until env-resolved or probed
+    'source': None,     # 'env' | 'probe'
+    'probe_ms': None,
+    'reason': None,     # why the probe disabled it (timeout/error)
+}
+
+
+def _reset_parallel_fetch():
+    """Test seam: forget the memoized concurrent-fetch verdict."""
+    _PARALLEL_FETCH.update(
+        enabled=None, source=None, probe_ms=None, reason=None)
+
+
+def _probe_parallel_fetch():
+    """One concurrent D2H fetch of two tiny device arrays, verified
+    byte-for-byte.  Plugins that serialize or deadlock concurrent
+    transfers fail here (the caller wraps us in run_with_deadline), so
+    the verdict is safe to memoize for the process lifetime."""
+    import concurrent.futures as cf
+    from .ops import get_jax
+    jax, _ = get_jax()
+    refs = [np.arange(256, dtype=np.int64) + i for i in range(2)]
+    devs = [jax.device_put(r) for r in refs]
+    for d in devs:
+        d.block_until_ready()
+    with cf.ThreadPoolExecutor(2) as ex:
+        out = list(ex.map(np.asarray, devs))
+    for ref, got in zip(refs, out):
+        if not np.array_equal(ref, got):
+            raise RuntimeError('concurrent fetch corrupted data')
+    return True
+
+
+def parallel_fetch_enabled():
+    """Whether D2H fetches may run on a thread pool.  DN_PARALLEL_FETCH
+    =1/0 overrides in either direction; otherwise the first call runs
+    one guarded concurrent-fetch probe (deadline-armored — a plugin
+    that wedges on concurrent transfers costs one short timeout, not a
+    hang) and the verdict sticks for the process.  Callers reach this
+    only after the backend is initialized, so the probe never triggers
+    a cold backend bring-up."""
+    if _PARALLEL_FETCH['enabled'] is not None:
+        return _PARALLEL_FETCH['enabled']
     import os
+    import time
+    env = os.environ.get('DN_PARALLEL_FETCH', '')
+    if env in ('0', '1'):
+        _PARALLEL_FETCH.update(
+            enabled=(env == '1'), source='env',
+            probe_ms=None, reason=None)
+    else:
+        t0 = time.monotonic()
+        status, res = run_with_deadline(
+            _probe_parallel_fetch, min(probe_deadline_s(), 10.0),
+            'parallel-fetch probe')
+        ms = round((time.monotonic() - t0) * 1e3, 3)
+        if status == 'ok':
+            _PARALLEL_FETCH.update(
+                enabled=True, source='probe', probe_ms=ms,
+                reason=None)
+        else:
+            reason = ('probe timeout' if status == 'timeout'
+                      else 'probe error: %s' % (res,))
+            _PARALLEL_FETCH.update(
+                enabled=False, source='probe', probe_ms=ms,
+                reason=reason)
+    from .obs import metrics as obs_metrics
+    obs_metrics.set_gauge(
+        'device_parallel_fetch',
+        1 if _PARALLEL_FETCH['enabled'] else 0)
+    return _PARALLEL_FETCH['enabled']
+
+
+def parallel_fetch_doc():
+    """Read-only /stats doc for the concurrent-fetch capability; never
+    triggers the probe (enabled=None means not yet resolved)."""
+    return dict(_PARALLEL_FETCH)
+
+
+def _fetch_arrays(arrays):
+    """np.asarray over several device arrays, on a small thread pool
+    when the probed concurrent-fetch capability (or DN_PARALLEL_FETCH
+    =1) allows it — measured ~40% faster over the tunnel, but
+    concurrent transfers can deadlock some device plugins, so the
+    capability is probed once rather than assumed."""
     from .obs import metrics as obs_metrics
     from .obs import trace as obs_trace
     arrays = list(arrays)
     with obs_trace.span('device_scan.d2h', narrays=len(arrays)) as sp:
-        if len(arrays) <= 1 or \
-                os.environ.get('DN_PARALLEL_FETCH', '0') != '1':
+        if len(arrays) <= 1 or not parallel_fetch_enabled():
             out = [np.asarray(a) for a in arrays]
         else:
             import concurrent.futures as cf

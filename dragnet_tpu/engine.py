@@ -54,6 +54,17 @@ def engine_mode():
     return os.environ.get('DN_ENGINE', 'auto')
 
 
+def index_device_mode():
+    """DN_INDEX_DEVICE routes the index-query aggregation lane:
+    'auto' (default) follows DN_ENGINE — forced jax engages the
+    device engine, auto escalates on a persisted audition win
+    (device_index.lane_decision); '1' forces the device lane
+    regardless of engine mode (with the usual clean host fallback);
+    '0' pins the host bincount even under DN_ENGINE=jax."""
+    v = os.environ.get('DN_INDEX_DEVICE', 'auto')
+    return v if v in ('auto', '0', '1') else 'auto'
+
+
 def _native_str_trans(column, parser_dict):
     """Engine-dictionary codes for a native parser's per-field string
     dictionary, cached on the engine column and extended incrementally

@@ -60,7 +60,6 @@ the existing allgather points reduce (parallel/cluster.py).
 """
 
 import os
-import sys
 
 import numpy as np
 
@@ -395,23 +394,16 @@ class _BreakdownStack(object):
 
 # -- device lane -----------------------------------------------------------
 
-# None = untested, True = usable, False = failed/timed out (sticky per
-# process, like the scan path's backend probe)
-_DEVICE_STATE = {'ready': None, 'warned': False}
+# The batched engine lives in device_index.py; this module keeps the
+# legacy single-dispatch `_device_sums` (the prewarm shapes and the
+# residency accumulator-pin tests exercise it directly) and shares the
+# sticky per-process availability verdict with it — one probe outcome
+# per process, whichever lane trips it first.
+from .device_index import _DEVICE_STATE          # noqa: E402
+from .device_index import _reset_device_state    # noqa: F401,E402
+from .device_index import _warn_device           # noqa: E402
+
 _SUMS_CACHE = {}
-
-
-def _reset_device_state():
-    """Test hook."""
-    _DEVICE_STATE['ready'] = None
-    _DEVICE_STATE['warned'] = False
-
-
-def _warn_device(reason):
-    if not _DEVICE_STATE['warned']:
-        _DEVICE_STATE['warned'] = True
-        sys.stderr.write('dn: warning: device index-query lane '
-                         'unavailable (%s); using host path\n' % reason)
 
 
 def _pow2(x):
@@ -542,17 +534,16 @@ def _device_sums(inv, weights, nuniq):
     return host
 
 
-def _aggregate_weights(inv, weights, nuniq, stage=None):
-    from .engine import engine_mode
-    if engine_mode() == 'jax':
-        dense = _device_sums(inv, weights, nuniq)
-        if dense is not None:
-            # hidden (the --counters bytes are pinned): lets `dn
-            # serve` /stats report device-lane engagement per request
-            if stage is not None:
-                stage.bump_hidden('index device sums', 1)
-            return dense
-    return np.bincount(inv, weights=weights, minlength=nuniq)
+def _aggregate_weights(inv, weights, nuniq, stage=None,
+                       shard_ctx=None):
+    """The aggregation seam: the batched device engine
+    (device_index.aggregate_weights — forced by DN_ENGINE=jax /
+    DN_INDEX_DEVICE=1, audition-escalated under auto) or the host
+    bincount, byte-identical either way.  Device engagement bumps
+    only HIDDEN counters (the --counters bytes are pinned)."""
+    from . import device_index as mod_di
+    return mod_di.aggregate_weights(inv, weights, nuniq, stage=stage,
+                                    shard_ctx=shard_ctx)
 
 
 # -- the stacked execution -------------------------------------------------
@@ -607,9 +598,10 @@ def run_stacked(paths, query, aggr, index_list):
     # per-shard path takes over.
     shards = []
     vals_list = []
+    idents = []
     state = {'total_abs': 0.0}
 
-    def on_blocks(sh):
+    def on_blocks(sh, path, statkey):
         v, ok = _shard_values(sh)
         if ok and len(v):
             state['total_abs'] += float(np.abs(v).sum())
@@ -618,6 +610,7 @@ def run_stacked(paths, query, aggr, index_list):
             raise _GateFailed()
         shards.append(sh)
         vals_list.append(v)
+        idents.append((path, statkey))
 
     from .obs import metrics as obs_metrics
     try:
@@ -695,17 +688,20 @@ def run_stacked(paths, query, aggr, index_list):
         first_idx, inv, order = _unique_rows(acols)
     nuniq = len(first_idx)
 
+    # rows are now shard-contiguous (the perm sorts shard-first) —
+    # exactly the slices the batched device engine stages per shard
+    sid = shard_ids[perm]
     with obs_metrics.timed_stage('index_query_stack.aggregate',
                                  nuniq=nuniq):
         wsum = _aggregate_weights(inv, values[perm], nuniq,
-                                  stage=index_list)
+                                  stage=index_list,
+                                  shard_ctx=(sid, idents, query))
     rows = first_idx[order]
     out_cols = [np.ascontiguousarray(c[rows]) for c in acols]
     weights = [int(w) for w in wsum[order].tolist()]
 
     # key-item counter parity: the per-shard loop merges one item per
     # DISTINCT tuple per shard
-    sid = shard_ids[perm]
     pair = fuse_codes([sid, inv])
     if pair is not None:
         npts = len(np.unique(pair))

@@ -96,6 +96,15 @@ def _member_row(name, st, latency=None):
     if resid.get('enabled'):
         row['device_residency_hit_rate'] = resid.get('hit_rate')
         row['device_pinned_bytes'] = resid.get('bytes')
+    # batched index-query offload: only members whose device lane has
+    # actually dispatched report (honest absence, like residency)
+    iq = ((st.get('device') or {}).get('index_query')) or {}
+    if iq.get('dispatches'):
+        row['index_device_dispatches'] = iq.get('dispatches')
+        row['index_device_shards_per_dispatch'] = \
+            iq.get('shards_per_dispatch')
+        row['index_device_h2d_saved_bytes'] = \
+            iq.get('h2d_saved_bytes', 0)
     roll = st.get('rollup') or {}
     if roll:
         row['rollup_coverage'] = roll.get('coverage_ratio')
@@ -273,6 +282,8 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
     cache_on = False
     resid_hits = resid_misses = resid_pinned = 0
     resid_on = False
+    iq_dispatches = iq_shards = iq_pin_hits = iq_saved = 0
+    iq_on = False
     roll_covered = roll_queried = 0
     compact_backlog = None
     for name in names:
@@ -324,6 +335,13 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
             resid_hits += rd.get('hits', 0) or 0
             resid_misses += rd.get('misses', 0) or 0
             resid_pinned += rd.get('bytes', 0) or 0
+        iqd = ((st.get('device') or {}).get('index_query')) or {}
+        if iqd.get('dispatches'):
+            iq_on = True
+            iq_dispatches += iqd.get('dispatches', 0) or 0
+            iq_shards += iqd.get('shards', 0) or 0
+            iq_pin_hits += iqd.get('pinned_shard_hits', 0) or 0
+            iq_saved += iqd.get('h2d_saved_bytes', 0) or 0
         roll = st.get('rollup') or {}
         roll_covered += roll.get('covered_shards', 0) or 0
         roll_queried += roll.get('shards_queried', 0) or 0
@@ -399,6 +417,16 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
         if resid_on and (resid_hits + resid_misses) else
         (0.0 if resid_on else None),
         'device_pinned_bytes': resid_pinned if resid_on else None,
+        # batched index-query offload: SUMMED dispatch/shard counts
+        # and pinned-shard H2D savings (None when no member's device
+        # index lane has engaged — honest absence)
+        'index_device_dispatches': iq_dispatches if iq_on else None,
+        'index_device_shards_per_dispatch': round(
+            iq_shards / iq_dispatches, 2)
+        if iq_on and iq_dispatches else (0.0 if iq_on else None),
+        'index_device_pinned_shard_hits':
+        iq_pin_hits if iq_on else None,
+        'index_device_h2d_saved_bytes': iq_saved if iq_on else None,
     }
     if agg_latency is not None and agg_latency.total:
         aggregate['latency'] = {
@@ -484,6 +512,12 @@ def fleet_prometheus_text(doc):
     if agg.get('device_pinned_bytes') is not None:
         reg.set_gauge('fleet_device_pinned_bytes',
                       agg['device_pinned_bytes'])
+    if agg.get('index_device_dispatches') is not None:
+        reg.set_gauge('fleet_index_device_dispatches',
+                      agg['index_device_dispatches'])
+    if agg.get('index_device_h2d_saved_bytes') is not None:
+        reg.set_gauge('fleet_index_device_h2d_saved_bytes',
+                      agg['index_device_h2d_saved_bytes'])
     lat = agg.get('latency')
     if lat:
         reg.set_gauge('fleet_latency_p50_ms', lat['p50'])

@@ -117,11 +117,20 @@ class DeviceResidency(object):
     invalidated by the writer epoch.  Thread-safe — the serve workers
     race on it."""
 
-    def __init__(self, budget_bytes):
+    def __init__(self, budget_bytes, shard_share=None):
         self.budget = int(budget_bytes or 0)
+        if shard_share is None:
+            import os
+            try:
+                shard_share = float(os.environ.get(
+                    'DN_INDEX_RESIDENCY_SHARE', '0.5'))
+            except ValueError:
+                shard_share = 0.5
+        self.shard_share = min(max(float(shard_share), 0.0), 1.0)
         self._lock = threading.Lock()
         self._entries = OrderedDict()
         self._bytes = 0
+        self._shard_bytes = 0
         self._hits = 0
         self._misses = 0
         self._stale = 0
@@ -140,14 +149,31 @@ class DeviceResidency(object):
             return
         del self._entries[key]
         self._bytes -= ent['nbytes']
+        if ent.get('kind') == 'shard':
+            self._shard_bytes -= ent['nbytes']
 
-    def _evict_lru_locked(self):
-        if not self._entries:
-            return False
-        key, ent = next(iter(self._entries.items()))
-        self._drop_locked(key, ent)
-        self._evictions += 1
-        return True
+    def _evict_lru_locked(self, kind=None):
+        for key, ent in self._entries.items():
+            if kind is not None and ent.get('kind') != kind:
+                continue
+            self._drop_locked(key, ent)
+            self._evictions += 1
+            return True
+        return False
+
+    def _evict_global_locked(self):
+        """Global-budget eviction prefers the host-side (whole-result)
+        pins: the shard share exists precisely so staged shard columns
+        survive distinct-query churn — whole-result pins only answer
+        exact repeats, so they are the cheaper loss.  Shard pins go
+        only when nothing else is left."""
+        for key, ent in self._entries.items():
+            if ent.get('kind') == 'shard':
+                continue
+            self._drop_locked(key, ent)
+            self._evictions += 1
+            return True
+        return self._evict_lru_locked(kind='shard')
 
     # -- the residency protocol --------------------------------------------
 
@@ -159,6 +185,8 @@ class DeviceResidency(object):
             return None
         with self._lock:
             ent = self._entries.get(key)
+            if ent is not None and ent.get('kind') == 'shard':
+                ent = None       # device-only pin: not this protocol
             if ent is not None and ent['epoch'] != epoch:
                 self._drop_locked(key, ent)
                 self._stale += 1
@@ -200,10 +228,70 @@ class DeviceResidency(object):
             if old is not None:
                 self._drop_locked(key, old)
             while self._bytes + nbytes > self.budget:
-                if not self._evict_lru_locked():
+                if not self._evict_global_locked():
                     break
             self._entries[key] = ent
             self._bytes += nbytes
+        return True
+
+    # -- per-shard device-tensor pins (device_index.py) --------------------
+
+    def get_device(self, key, epoch):
+        """The pinned DEVICE tensors for a staged shard (tuple of
+        jax arrays), or None.  Unlike get(), nothing is fetched — a
+        hit hands the device references straight back into the next
+        dispatch and books only the H2D upload it skipped."""
+        if not self.enabled() or key is None:
+            return None
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and (ent.get('kind') != 'shard'
+                                    or ent['epoch'] != epoch
+                                    or _device_deleted(ent['device'])):
+                if ent.get('kind') == 'shard':
+                    self._drop_locked(key, ent)
+                    self._stale += 1
+                ent = None
+            if ent is None:
+                self._misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self._hits += 1
+            self._h2d_saved += ent['h2d_bytes']
+            return ent['device']
+
+    def put_device(self, key, epoch, device, nbytes, h2d_bytes=None):
+        """Pin one shard's staged device tensors (no host copy — the
+        host never needs them back).  Bounded twice: by the global HBM
+        budget AND by the shard share (DN_INDEX_RESIDENCY_SHARE of the
+        budget), so shard columns cannot starve the pinned
+        accumulators that answer exact repeats with zero transfer."""
+        if not self.enabled() or key is None:
+            return False
+        nbytes = int(nbytes or 0)
+        cap = int(self.budget * self.shard_share)
+        if nbytes <= 0 or nbytes > cap:
+            with self._lock:
+                self._shed += 1
+            return False
+        ent = {'epoch': epoch, 'device': device, 'host': None,
+               'nbytes': nbytes, 'kind': 'shard',
+               'h2d_bytes': int(h2d_bytes if h2d_bytes is not None
+                                else nbytes),
+               'ts': time.time()}
+        with self._lock:
+            old = self._entries.get(key)
+            if old is not None:
+                self._drop_locked(key, old)
+            while self._shard_bytes + nbytes > cap:
+                if not self._evict_lru_locked(kind='shard'):
+                    break
+            while self._bytes + nbytes > self.budget:
+                if not self._evict_global_locked():
+                    break
+            self._entries[key] = ent
+            self._bytes += nbytes
+            self._shard_bytes += nbytes
         return True
 
     def clear(self):
@@ -213,6 +301,17 @@ class DeviceResidency(object):
             for key, ent in list(self._entries.items()):
                 self._drop_locked(key, ent)
 
+    def drop_host_pins(self):
+        """Drop every whole-result (host-copy) pin, keeping the shard
+        pins — the state distinct-query churn converges to under
+        budget pressure (_evict_global_locked goes host-first).  Bench
+        and tests use this to exercise the pinned-shard repeat path
+        deterministically."""
+        with self._lock:
+            for key, ent in list(self._entries.items()):
+                if ent.get('kind') != 'shard':
+                    self._drop_locked(key, ent)
+
     def stats(self):
         with self._lock:
             hits, misses = self._hits, self._misses
@@ -221,6 +320,8 @@ class DeviceResidency(object):
                 'budget_bytes': self.budget,
                 'bytes': self._bytes,
                 'entries': len(self._entries),
+                'shard_bytes': self._shard_bytes,
+                'shard_share': self.shard_share,
                 'hits': hits,
                 'misses': misses,
                 'stale_drops': self._stale,

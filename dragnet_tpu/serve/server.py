@@ -342,6 +342,13 @@ class DnServer(object):
         if isinstance(dev_conf, DNError):
             raise dev_conf
         self.device_conf = dev_conf
+        # index-query device-lane knobs (device_index.py) validated
+        # with the same fail-fast contract; the residency share caps
+        # how much HBM pinned shard tensors may occupy
+        iq_conf = mod_config.index_device_config()
+        if isinstance(iq_conf, DNError):
+            raise iq_conf
+        self.index_device_conf = iq_conf
         mod_residency.configure(dev_conf['residency_mb'] << 20)
         self._prewarm_doc = None
         # fleet observability (obs/history.py, obs/events.py,
@@ -908,6 +915,20 @@ class DnServer(object):
             'batch_floor': int(reg.gauge('device_batch_floor').value),
         }
 
+    def _index_query_doc(self):
+        """Batched index-query offload telemetry (device_index):
+        engagement counters plus the resolved lane mode, shaped for
+        /stats alongside the scan-lane pipeline doc."""
+        from .. import device_index as mod_di
+        doc = mod_di.stats_doc()
+        doc['mode'] = self.index_device_conf['mode']
+        doc['batch_rows'] = self.index_device_conf['batch_rows']
+        return doc
+
+    def _parallel_fetch_doc(self):
+        from .. import device_scan as mod_ds
+        return mod_ds.parallel_fetch_doc()
+
     def _scan_merge_doc(self):
         from .. import scan_mt as mod_scan_mt
         ms = mod_scan_mt.merge_stats()
@@ -964,6 +985,14 @@ class DnServer(object):
                 # depth, dispatch/overlap counters, and how much of
                 # the H2D upload volume rode under compute
                 'pipeline': self._pipeline_doc(),
+                # batched index-query offload (device_index):
+                # dispatch/shard/row engagement, pinned-shard hits
+                # and the H2D bytes residency pins saved
+                'index_query': self._index_query_doc(),
+                # probed concurrent-fetch capability (device_scan);
+                # doc records whether the default came from the env
+                # override or the one-shot probe
+                'parallel_fetch': self._parallel_fetch_doc(),
             },
             # radix-partitioned MT merge telemetry (scan_mt): the
             # configured partition count and the accumulated

@@ -113,10 +113,19 @@ def render_frame(doc, ansi=True):
     # (host-only fleets keep the old frame byte-for-byte)
     if agg.get('device_residency_hit_rate') is not None or \
             agg.get('device_pinned_bytes') is not None:
-        lines.append(
-            'device resid hit %s  pinned %s'
-            % (_fmt(agg.get('device_residency_hit_rate')),
-               _fmt_bytes(agg.get('device_pinned_bytes'))))
+        dev = ('device resid hit %s  pinned %s'
+               % (_fmt(agg.get('device_residency_hit_rate')),
+                  _fmt_bytes(agg.get('device_pinned_bytes'))))
+        # index-query offload column: only once some member's device
+        # index lane has dispatched (idle lanes keep the line short)
+        if agg.get('index_device_dispatches') is not None:
+            dev += ('  iq disp %s  sh/disp %s  h2d saved %s'
+                    % (_fmt(agg.get('index_device_dispatches')),
+                       _fmt(agg.get(
+                           'index_device_shards_per_dispatch')),
+                       _fmt_bytes(agg.get(
+                           'index_device_h2d_saved_bytes'))))
+        lines.append(dev)
     if doc.get('members_read_only'):
         lines.append('%sDISK: %d member(s) read-only (min free %s%%)'
                      '%s'

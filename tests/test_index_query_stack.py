@@ -418,9 +418,11 @@ def test_device_lane_deadline_armor(tmp_path, monkeypatch, capsys):
     mod_iqs._reset_device_state()
     monkeypatch.setenv('DN_ENGINE', 'jax')
     monkeypatch.setenv('DN_DEVICE_PROBE_TIMEOUT', '0.2')
+    from dragnet_tpu import device_index as mod_di
     monkeypatch.setattr(
-        mod_iqs, '_sums_program',
-        lambda pn, pu: (lambda seg, w: mod_time.sleep(60)))
+        mod_di, '_fold_program',
+        lambda s, r, t, pu:
+        (lambda locs, ws, ttabs, acc: mod_time.sleep(60)))
     pts = ds.query(_query(QUERIES[0]), 'day').points
     assert pts == host
     assert mod_iqs._DEVICE_STATE['ready'] is False
