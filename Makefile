@@ -6,9 +6,11 @@ PYTHON ?= python3
 
 .PHONY: all native test check bench bench-iq bench-iq-device \
     bench-build bench-parse \
-    bench-serve bench-cluster bench-follow bench-fanin bench-verify \
+    bench-serve bench-cluster bench-follow bench-subscribe \
+    bench-fanin bench-verify \
     soak-faults soak-cluster soak-follow soak-compact \
     soak-overload soak-rebalance soak-scrub soak-resources \
+    soak-subscribe \
     clean parity-matrix
 
 all: native
@@ -101,6 +103,12 @@ soak-compact: native
 bench-follow: native
 	$(PYTHON) bench.py --follow-only
 
+# the standing-query legs only: publish-to-push latency p50/p95 and
+# the N-subscriber fan-out vs N pollers — counter-asserts one
+# incremental merge per publish, not N aggregations (extras JSON)
+bench-subscribe: native
+	$(PYTHON) bench.py --subscribe-only
+
 # the overload drill: multi-tenant flood at ~5x capacity against the
 # 3-member cluster with torn-frame/stall/flood faults armed, tenant
 # weights 3:1, and a mid-flood SIGKILL of one member — asserts zero
@@ -136,6 +144,15 @@ soak-scrub: native
 # resumption on recovery, zero torn shards, zero stranded tmps
 soak-resources: native
 	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --resources
+
+# the standing-query drill: a `dn subscribe` flood over the 3-member
+# cluster while publishes land under armed push/transport faults
+# (torn push frames force token resume), with a publisher subprocess
+# and a CLI subscriber SIGKILLed mid-stream — asserts pushed-vs-polled
+# byte identity at every quiescent epoch, zero torn shards after the
+# publisher kill, dead-subscriber shedding, and zero wedges
+soak-subscribe: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/soak_faults.py --subscribe
 
 # verified-read overhead: warm + cold-open index-query p50/p95 under
 # DN_VERIFY=open vs off (bench extras JSON)

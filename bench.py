@@ -1444,6 +1444,249 @@ def main_serve():
     }))
 
 
+def subscribe_bench(tmpdir):
+    """The standing-query legs (--subscribe-only / make
+    bench-subscribe): N subscribers hold one standing query against
+    an embedded `dn serve` while a publisher appends records and
+    merge-publishes the last day's shards.
+
+    * publish-to-push latency: publish committed -> every subscriber
+      holds the new frame (p50/p95 over DN_BENCH_SUB_REPS publishes;
+      the DN_SUB_COALESCE_MS batching window is part of the measured
+      number ON PURPOSE — it is the latency a dashboard experiences);
+    * fan-out economics, counter-asserted: N subscribers x P
+      publishes cost exactly P group recomputes (ONE incremental
+      merge per publish, not N aggregations) and N*P pushes, while N
+      pollers pay N full queries per refresh;
+    * byte identity: every pushed frame must equal a fresh poll."""
+    import queue as mod_queue
+    import threading
+    from dragnet_tpu import config as mod_config
+    from dragnet_tpu.serve import client as mod_scl
+    from dragnet_tpu.serve import server as mod_srv
+
+    n = int(os.environ.get('DN_BENCH_SUB_RECORDS', '60000'))
+    reps = int(os.environ.get('DN_BENCH_SUB_REPS', '8'))
+    nsubs = int(os.environ.get('DN_BENCH_SUB_FANOUT', '8'))
+    burst = int(os.environ.get('DN_BENCH_SUB_BURST', '400'))
+    days = 5
+
+    datafile = os.path.join(tmpdir, 'sub.log')
+    idx = os.path.join(tmpdir, 'sub.idx')
+    rc_path = os.path.join(tmpdir, 'sub_rc.json')
+    sock = os.path.join(tmpdir, 'dn.sock')
+    start_ms = 1388534400000             # 2014-01-01
+    end_ms = start_ms + days * 86400000
+    last_day_ms = end_ms - 86400000
+    gen_to_file(n, datafile, mindate_ms=start_ms, maxdate_ms=end_ms)
+
+    cfg = mod_config.create_initial_config()
+    cfg = cfg.datasource_add({
+        'name': 'subbench', 'backend': 'file',
+        'backend_config': {'path': datafile, 'indexPath': idx,
+                           'timeField': 'time'},
+        'filter': None, 'dataFormat': 'json'})
+    for m in METRICS:
+        cfg = cfg.metric_add({'name': m['name'],
+                              'datasource': 'subbench',
+                              'filter': m.get('filter'),
+                              'breakdowns': m['breakdowns']})
+    mod_config.ConfigBackendLocal(rc_path).save(cfg.serialize())
+
+    metrics = [mod_query.metric_deserialize(dict(m)) for m in METRICS]
+    ds = make_ds(datafile, idx)
+    ds.build(metrics, 'day')
+    nshards = _count_shards(idx)
+
+    prior = os.environ.get('DN_SUB_COALESCE_MS')
+    os.environ['DN_SUB_COALESCE_MS'] = '10'
+    srv = mod_srv.DnServer(
+        socket_path=sock,
+        conf={'max_inflight': 8, 'queue_depth': 32, 'deadline_ms': 0,
+              'coalesce': True, 'drain_s': 10}).start()
+    try:
+        qdoc = {'breakdowns': [
+            {'name': 'host', 'field': 'host'},
+            {'name': 'latency', 'field': 'latency',
+             'aggr': 'quantize'}],
+            'filter': {'eq': ['req.method', 'GET']}}
+        sub_req = {'op': 'subscribe', 'ds': 'subbench',
+                   'config': rc_path, 'interval': 'day',
+                   'queryconfig': qdoc, 'opts': {}}
+        poll_req = {'op': 'query', 'ds': 'subbench',
+                    'config': rc_path, 'interval': 'day',
+                    'queryconfig': qdoc, 'opts': {}}
+
+        # each subscriber: a reader thread draining its stream into
+        # a queue (receipt-stamped), so fan-out latency is measured
+        # at the consumer, concurrently for all N
+        streams = [mod_scl.subscribe_stream(sock, dict(sub_req))
+                   for _ in range(nsubs)]
+        queues = [mod_queue.Queue() for _ in range(nsubs)]
+
+        def reader(stream, q):
+            from dragnet_tpu.errors import DNError
+            try:
+                for fr in stream:
+                    q.put((time.monotonic(), fr))
+            except DNError:
+                pass
+            q.put(None)
+
+        threads = [threading.Thread(target=reader, args=(s, q),
+                                    daemon=True)
+                   for s, q in zip(streams, queues)]
+        for t in threads:
+            t.start()
+        seeds = [q.get(timeout=120)[1] for q in queues]
+        rc0, _, poll_out, _ = mod_scl.request_bytes(
+            sock, dict(poll_req))
+        assert rc0 == 0
+        identical = all(fr['payload'] == poll_out for fr in seeds)
+
+        before = mod_scl.stats(sock)['subscriptions']['counters']
+        mod = _mktestdata()
+        lat_all = []
+        lat_first = []
+        per_sub_frames = [0] * nsubs
+        bi = n
+        final_poll = poll_out
+        for rep in range(reps):
+            with open(datafile, 'a') as f:
+                for _ in range(burst):
+                    f.write(json.dumps(
+                        mod.make_record(bi % n, n, last_day_ms,
+                                        end_ms),
+                        separators=(',', ':')) + '\n')
+                    bi += 1
+            ds.build(metrics, 'day', time_after=last_day_ms,
+                     time_before=end_ms)
+            t0 = time.monotonic()
+            rcp, _, final_poll, _ = mod_scl.request_bytes(
+                sock, dict(poll_req))
+            assert rcp == 0
+            # a publish whose write hooks straddle a coalesce window
+            # may push an intermediate frame first: drain each
+            # subscriber to the COMMITTED bytes (the fresh poll)
+            stamps = []
+            for i, q in enumerate(queues):
+                while True:
+                    item = q.get(timeout=120)
+                    assert item is not None, 'stream died mid-bench'
+                    per_sub_frames[i] += 1
+                    if item[1]['payload'] == final_poll:
+                        stamps.append(item[0])
+                        break
+            lat_first.append((min(stamps) - t0) * 1000)
+            lat_all.append((max(stamps) - t0) * 1000)
+        after = mod_scl.stats(sock)['subscriptions']['counters']
+        recomputes = after['recomputes'] - before['recomputes']
+        pushes = after['pushes'] - before['pushes']
+        # THE economics contract: per-publish cost is O(1) in
+        # subscriber count — each pushed version cost ONE incremental
+        # merge shared by all N subscribers (a publish may split
+        # across coalesce windows, but never multiplies by N), where
+        # N pollers would have paid N full aggregations per refresh
+        versions = per_sub_frames[0]
+        if per_sub_frames != [versions] * nsubs:
+            raise RuntimeError('subscribers diverged: %r'
+                               % (per_sub_frames,))
+        if pushes != versions * nsubs:
+            raise RuntimeError('expected %d pushes (%d versions x %d '
+                               'subscribers), got %d'
+                               % (versions * nsubs, versions, nsubs,
+                                  pushes))
+        if not reps <= recomputes <= 2 * reps + 1:
+            raise RuntimeError('expected ~%d recomputes for %d '
+                               'publishes (never %d), got %d'
+                               % (reps, reps, reps * nsubs,
+                                  recomputes))
+
+        # the polling alternative: N pollers refreshing once — N
+        # full queries through admission, per refresh, forever
+        t0 = time.monotonic()
+        for _ in range(nsubs):
+            rcp, _, pout, _ = mod_scl.request_bytes(
+                sock, dict(poll_req))
+            assert rcp == 0
+            identical = identical and pout == final_poll
+        poll_fanout_ms = (time.monotonic() - t0) * 1000
+
+        # stopping the server pushes every subscriber an 'end' frame,
+        # which exhausts the reader generators cleanly (a generator
+        # blocked in next() cannot be close()d from here)
+        srv.stop()
+        for t in threads:
+            t.join(timeout=10)
+
+        lat_all.sort()
+        lat_first.sort()
+        p50 = lat_all[len(lat_all) // 2]
+        p95 = lat_all[min(len(lat_all) - 1,
+                          int(len(lat_all) * 0.95))]
+        return {
+            'sub_records': n,
+            'sub_shards': nshards,
+            'sub_subscribers': nsubs,
+            'sub_publishes': reps,
+            'sub_burst_records': burst,
+            'sub_publish_to_push_p50_ms': round(p50, 1),
+            'sub_publish_to_push_p95_ms': round(p95, 1),
+            'sub_publish_to_first_push_p50_ms': round(
+                lat_first[len(lat_first) // 2], 1),
+            'sub_recomputes_per_publish': round(recomputes / reps,
+                                                2),
+            'sub_merges_if_polled': reps * nsubs,
+            'sub_pushes': pushes,
+            'sub_shards_folded': (after['shards_folded'] -
+                                  before['shards_folded']),
+            'sub_shards_reused': (after['shards_reused'] -
+                                  before['shards_reused']),
+            'sub_poller_fanout_ms': round(poll_fanout_ms, 1),
+            'sub_frames_delta': after['frames_delta'],
+            'sub_output_byte_identical': identical,
+        }
+    finally:
+        srv.stop()
+        if prior is None:
+            os.environ.pop('DN_SUB_COALESCE_MS', None)
+        else:
+            os.environ['DN_SUB_COALESCE_MS'] = prior
+
+
+def main_subscribe():
+    """Standing-query legs only (`make bench-subscribe` /
+    --subscribe-only)."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='dn_bench_sub_')
+    try:
+        sb = subscribe_bench(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    sys.stderr.write(
+        'bench-subscribe: %d subscribers x %d publishes; publish-to-'
+        'push p50 %.1fms p95 %.1fms (first %.1fms); %.1f recomputes/'
+        'publish (%d pushes, %d folded / %d reused shards); %d '
+        'pollers refresh %.1fms; delta frames %d; identical %s\n'
+        % (sb['sub_subscribers'], sb['sub_publishes'],
+           sb['sub_publish_to_push_p50_ms'],
+           sb['sub_publish_to_push_p95_ms'],
+           sb['sub_publish_to_first_push_p50_ms'],
+           sb['sub_recomputes_per_publish'], sb['sub_pushes'],
+           sb['sub_shards_folded'], sb['sub_shards_reused'],
+           sb['sub_subscribers'], sb['sub_poller_fanout_ms'],
+           sb['sub_frames_delta'],
+           sb['sub_output_byte_identical']))
+    print(json.dumps({
+        'metric': 'sub_publish_to_push_p50_ms',
+        'value': sb['sub_publish_to_push_p50_ms'],
+        'unit': 'ms',
+        'vs_baseline': None,
+        'extra': sb,
+    }))
+
+
 def cluster_bench(tmpdir):
     """The scatter-gather cluster legs (--cluster-only / make
     bench-cluster): the same warm index-query workload as bench-serve,
@@ -2235,6 +2478,9 @@ def main():
     if '--follow-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'follow':
         return main_follow()
+    if '--subscribe-only' in sys.argv[1:] or \
+            os.environ.get('DN_BENCH_ONLY') == 'subscribe':
+        return main_subscribe()
     if '--fanin-only' in sys.argv[1:] or \
             os.environ.get('DN_BENCH_ONLY') == 'fanin':
         return main_fanin()

@@ -161,6 +161,14 @@ DN_OPTIONS = [
     # everything).  Not in USAGE_TEXT (byte-pinned); documented in
     # docs/robustness.md.
     (['min-gens'], 'string', None),
+    # `dn subscribe` / `dn top --subscribe` standing-query options:
+    # --subscribe switches `dn top` from fleet_stats polling to the
+    # server push path, --frames bounds a `dn subscribe` stream to N
+    # pushed frames (0 = run until interrupted; used by tests and
+    # scripts that want one refresh).  Not in USAGE_TEXT (byte-pinned);
+    # documented in docs/serving.md.
+    (['subscribe'], 'bool', None),
+    (['frames'], 'string', None),
     # per-run request tracing (equivalent to DN_TRACE=stderr for one
     # command; composes with --remote — the client ships its trace id
     # and grafts the server's span subtree).  Not in USAGE_TEXT: the
@@ -1083,7 +1091,7 @@ def cmd_top(ctx, argv):
     against a non-cluster server.  --once prints one frame with no
     ANSI codes and exits.  Not in USAGE_TEXT (byte-pinned);
     documented in docs/observability.md."""
-    opts = dn_parse_args(argv, ['remote', 'once'])
+    opts = dn_parse_args(argv, ['remote', 'once', 'subscribe'])
     check_arg_count(opts, 0)
     if not opts.remote:
         raise UsageError('"--remote" is required for "top"')
@@ -1095,9 +1103,103 @@ def cmd_top(ctx, argv):
         return mod_top.top_main(opts.remote,
                                 obs_conf['top_interval_ms'],
                                 once=bool(getattr(opts, 'once',
-                                                  None)))
+                                                  None)),
+                                subscribe=bool(getattr(opts,
+                                                       'subscribe',
+                                                       None)))
     except KeyboardInterrupt:
         return 0
+
+
+def cmd_subscribe(ctx, argv):
+    """`dn subscribe --remote SOCK|HOST:PORT [QUERY OPTIONS]
+    [--frames=N] DATASOURCE`: register a standing query on the server
+    (serve/subscribe.py) and stream pushed result frames as JSONL —
+    one JSON object per frame with kind/seq/epoch/payload/token.  The
+    payload at epoch E is byte-identical to `dn query --remote` at
+    epoch E; the token in each frame resumes the stream after a
+    disconnect without a reseed when the result is unchanged.
+    --frames=N exits 0 after N pushed frames (the seed counts).  Not
+    in USAGE_TEXT (byte-pinned); documented in docs/serving.md."""
+    opts = dn_parse_args(argv, ['before', 'after', 'filter',
+                                'breakdowns', 'raw', 'points',
+                                'interval', 'remote', 'frames'])
+    check_arg_count(opts, 1)
+    dsname = opts._args[0]
+    if not opts.remote:
+        raise UsageError('"--remote" is required for "subscribe"')
+    nframes = 0
+    if getattr(opts, 'frames', None) is not None:
+        try:
+            nframes = int(opts.frames)
+        except ValueError:
+            nframes = -1
+        if nframes < 0:
+            fatal(DNError('"--frames" expects a non-negative '
+                          'integer, got "%s"' % opts.frames))
+    # validates the query flags locally (same contract as cmd_query)
+    # before shipping the doc
+    dn_query_config(opts)
+    req = {
+        'op': 'subscribe', 'ds': dsname,
+        'interval': opts.interval,
+        'queryconfig': dn_query_doc(opts),
+        'opts': {'raw': bool(getattr(opts, 'raw', None)),
+                 'points': bool(getattr(opts, 'points', None))},
+        'config': ctx['backend'].cbl_path,
+    }
+    import json as mod_json
+    import time as mod_time
+    from .serve import client as mod_serve_client
+    from .serve.client import (SubscribeUnsupported,
+                               RemoteTransportError)
+
+    def emit(frame):
+        line = mod_json.dumps({
+            'kind': frame['kind'],
+            'seq': frame['seq'],
+            'epoch': frame['epoch'],
+            'payload': frame['payload'].decode('utf-8',
+                                               'replace'),
+            'token': frame['token'],
+        }, sort_keys=True)
+        sys.stdout.write(line + '\n')
+        sys.stdout.flush()
+
+    resume = None
+    emitted = 0
+    failures = 0
+    while True:
+        stream = mod_serve_client.subscribe_stream(
+            opts.remote, dict(req), resume=resume)
+        try:
+            for frame in stream:
+                failures = 0
+                resume = (frame['token'], frame['payload'])
+                # a resume-matched 'current' frame repeats bytes the
+                # consumer already has — refresh the token, skip the
+                # line (and the --frames budget)
+                if frame['kind'] != 'current':
+                    emit(frame)
+                    emitted += 1
+                if nframes and emitted >= nframes:
+                    return 0
+            return 0  # server sent a clean 'end' frame
+        except SubscribeUnsupported as e:
+            sys.stderr.write('dn: %s\n' % e.message)
+            return 1
+        except RemoteTransportError:
+            failures += 1
+            if failures > 5 or resume is None:
+                raise FatalError('subscription stream lost and '
+                                 'reconnect failed')
+            mod_time.sleep(min(2.0, 0.1 * (2 ** failures)))
+        except DNError as e:
+            fatal(e)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            stream.close()
 
 
 def cmd_follow(ctx, argv):
@@ -1601,6 +1703,9 @@ def cmd_serve(ctx, argv):
     iq_conf = mod_config.index_device_config()
     if isinstance(iq_conf, DNError):
         fatal(iq_conf)
+    sub_conf = mod_config.subscribe_config()
+    if isinstance(sub_conf, DNError):
+        fatal(sub_conf)
 
     cluster = opts.cluster or os.environ.get('DN_SERVE_TOPOLOGY') \
         or None
@@ -1674,6 +1779,11 @@ def cmd_serve(ctx, argv):
                obs_conf['events_file'] or 'off',
                obs_conf['top_interval_ms'],
                conf['fleet_timeout_s']))
+        sys.stdout.write(
+            'subscribe config ok: max=%d coalesce_ms=%d '
+            'queue_depth=%d delta_pct=%d\n'
+            % (sub_conf['max'], sub_conf['coalesce_ms'],
+               sub_conf['queue_depth'], sub_conf['delta_pct']))
         sys.stdout.write(
             'router config ok: probe_ms=%d failures=%d '
             'cooldown_ms=%d hedge_ms=%d fetch_timeout_s=%d '
@@ -1877,6 +1987,7 @@ COMMANDS = {
     'scrub': cmd_scrub,
     'serve': cmd_serve,
     'stats': cmd_stats,
+    'subscribe': cmd_subscribe,
     'top': cmd_top,
     'topo': cmd_topo,
 }

@@ -418,6 +418,59 @@ def serve_config(env=None):
     return rv
 
 
+# --- standing-query subscription knobs (DN_SUB_*) ---------------------
+#
+# Same contract as the serve knobs: parsed and validated in one place
+# (serve/subscribe.py consumes them; `dn serve --validate` checks them
+# up front).  Each entry: (env name, kind, default, min).
+
+_SUB_KNOBS = [
+    # registered subscriptions across the process; 0 disables the
+    # subsystem (subscribe requests answer a clean error)
+    ('DN_SUB_MAX', 'int', 64, 0),
+    # the push-coalesce latency: how long a dirty standing query
+    # waits for more publishes before recomputing and pushing (the
+    # target publish-to-push bound), and the cadence at which
+    # cross-process writes are detected via the tree validators
+    ('DN_SUB_COALESCE_MS', 'int', 250, 10),
+    # unacked frames a subscriber may have outstanding before the
+    # manager stops pushing to IT (degrading to one coalesced full
+    # frame when its acks catch up) — the backpressure bound that
+    # keeps one stalled dashboard from queueing unbounded frames
+    ('DN_SUB_QUEUE_DEPTH', 'int', 4, 1),
+    # deltas are only worth the patch bookkeeping when they shrink
+    # the frame: send a delta only if the inserted span is at most
+    # this percentage of the full payload (0 disables deltas —
+    # every push is a full frame)
+    ('DN_SUB_DELTA_PCT', 'int', 50, 0),
+]
+
+
+def subscribe_config(env=None):
+    """The resolved DN_SUB_* knob dict (keys: max, coalesce_ms,
+    queue_depth, delta_pct), or DNError on the first malformed value
+    — 'DN_SUB_X: expected ..., got "v"'."""
+    if env is None:
+        env = os.environ
+    rv = {}
+    for name, kind, default, minimum in _SUB_KNOBS:
+        key = name[len('DN_SUB_'):].lower()
+        raw = env.get(name)
+        if raw is None or raw == '':
+            rv[key] = default
+            continue
+        try:
+            value = int(raw)
+        except ValueError:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        if value < minimum:
+            return DNError('%s: expected an integer >= %d, got "%s"'
+                           % (name, minimum, raw))
+        rv[key] = value
+    return rv
+
+
 # --- remote-client retry knobs (DN_REMOTE_*) --------------------------
 #
 # Same contract as the serve knobs: parsed and validated in one place

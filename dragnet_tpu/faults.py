@@ -76,6 +76,7 @@ SITES = (
     'serve.read',       # dn serve: request read/parse
     'serve.write',      # dn serve: response write
     'serve.frame_torn',  # dn serve: v2 response framing (torn frame)
+    'serve.push_torn',  # dn serve: subscription push framing (torn)
     'serve.stall',      # dn serve: per-request handling stall
     'tenant.flood',     # admission: per-tenant enqueue (overload)
     'client.connect',   # remote client: connect()

@@ -105,6 +105,11 @@ def _member_row(name, st, latency=None):
             iq.get('shards_per_dispatch')
         row['index_device_h2d_saved_bytes'] = \
             iq.get('h2d_saved_bytes', 0)
+    # standing queries: active subscriber count per member (honest
+    # absence when the member runs with DN_SUB_MAX=0)
+    subs = st.get('subscriptions') or {}
+    if subs.get('enabled'):
+        row['subscriptions'] = subs.get('active', 0)
     roll = st.get('rollup') or {}
     if roll:
         row['rollup_coverage'] = roll.get('coverage_ratio')
@@ -286,6 +291,8 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
     iq_on = False
     roll_covered = roll_queried = 0
     compact_backlog = None
+    sub_active = sub_pushes = 0
+    sub_on = False
     for name in names:
         st = stats.get(name)
         if st is None:
@@ -349,6 +356,12 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
         if maint is not None:
             compact_backlog = (compact_backlog or 0) + \
                 (maint.get('compact_backlog') or 0)
+        sb = st.get('subscriptions') or {}
+        if sb.get('enabled'):
+            sub_on = True
+            sub_active += sb.get('active', 0) or 0
+            sub_pushes += ((sb.get('counters') or {})
+                           .get('pushes', 0)) or 0
         fl = st.get('follow')
         if fl is not None:
             follow[name] = {'ingest_lag_ms': fl.get('ingest_lag_ms'),
@@ -427,6 +440,11 @@ def merge_fleet(server, names, stats, events, errors, timeout_s=None):
         'index_device_pinned_shard_hits':
         iq_pin_hits if iq_on else None,
         'index_device_h2d_saved_bytes': iq_saved if iq_on else None,
+        # standing queries: SUMMED active subscribers and lifetime
+        # pushes (None when no member enables subscriptions —
+        # honest absence)
+        'subscriptions': sub_active if sub_on else None,
+        'subscription_pushes': sub_pushes if sub_on else None,
     }
     if agg_latency is not None and agg_latency.total:
         aggregate['latency'] = {
@@ -518,6 +536,11 @@ def fleet_prometheus_text(doc):
     if agg.get('index_device_h2d_saved_bytes') is not None:
         reg.set_gauge('fleet_index_device_h2d_saved_bytes',
                       agg['index_device_h2d_saved_bytes'])
+    if agg.get('subscriptions') is not None:
+        reg.set_gauge('fleet_subscriptions', agg['subscriptions'])
+    if agg.get('subscription_pushes') is not None:
+        reg.inc('fleet_subscription_pushes_total',
+                agg['subscription_pushes'])
     lat = agg.get('latency')
     if lat:
         reg.set_gauge('fleet_latency_p50_ms', lat['p50'])

@@ -68,7 +68,8 @@ class Conn(object):
     __slots__ = ('sock', 'fd', 'peer', 'rbuf', 'wbufs', 'wpos',
                  'proto', 'inflight', 'close_after_flush', 'closed',
                  'last_activity', 'read_started', 'write_started',
-                 'inflight_ids', 'ids_lock', 'paused', 'registered')
+                 'inflight_ids', 'ids_lock', 'paused', 'registered',
+                 'pinned')
 
     def __init__(self, sock, peer):
         self.sock = sock
@@ -89,6 +90,7 @@ class Conn(object):
         self.ids_lock = threading.Lock()
         self.paused = False         # v1: one request, then no reads
         self.registered = False     # currently in the selector
+        self.pinned = 0             # live subscriptions: no idle reap
 
     def pending_write(self):
         return bool(self.wbufs)
@@ -102,12 +104,16 @@ class IOLoop(object):
     by returning False (fault injection)."""
 
     def __init__(self, listener, conf, on_request, on_overflow=None,
-                 on_accept=None, log=None):
+                 on_accept=None, on_close=None, log=None):
         self.listener = listener
         self.conf = conf
         self.on_request = on_request
         self.on_overflow = on_overflow
         self.on_accept = on_accept
+        # on_close(conn) fires on the loop thread for every closed
+        # connection — how a SubscriptionManager learns its peer died
+        # (serve/subscribe.py).  Must be quick and must not raise.
+        self.on_close = on_close
         self.log = log
         self._sel = selectors.DefaultSelector()
         listener.setblocking(False)
@@ -156,6 +162,18 @@ class IOLoop(object):
         """Close `conn` without a response (fault injection, torn
         frames)."""
         self._enqueue(('close', conn, None, False, completes))
+
+    def pin(self, conn):
+        """Exempt `conn` from idle reaping (thread-safe): a
+        registered subscriber is QUIET by design — no requests, no
+        pending writes between pushes — and must not be garbage-
+        collected as an fd leak.  Counted, so overlapping
+        subscriptions compose; the read/write deadlines still apply
+        (a wedged peer is reaped, pinned or not)."""
+        self._enqueue(('pin', conn, None, False, False))
+
+    def unpin(self, conn):
+        self._enqueue(('unpin', conn, None, False, False))
 
     def stop_accepting(self):
         self._enqueue(('stop_accept', None, None, False, False))
@@ -254,6 +272,12 @@ class IOLoop(object):
                 continue
             if completes:
                 conn.inflight = max(0, conn.inflight - 1)
+            if kind == 'pin':
+                conn.pinned += 1
+                continue
+            if kind == 'unpin':
+                conn.pinned = max(0, conn.pinned - 1)
+                continue
             if kind == 'close':
                 self._close(conn)
                 continue
@@ -428,7 +452,7 @@ class IOLoop(object):
                 self._bump('reaped_write_deadline')
                 self._close(conn)
                 continue
-            if idle and not conn.inflight and \
+            if idle and not conn.inflight and not conn.pinned and \
                     not conn.pending_write() and \
                     conn.rbuf.pending() == 0 and \
                     (now - conn.last_activity) * 1000.0 >= idle:
@@ -441,6 +465,11 @@ class IOLoop(object):
         conn.closed = True
         self._conns.pop(conn.fd, None)
         self._bump('conns_closed')
+        if self.on_close is not None:
+            try:
+                self.on_close(conn)
+            except Exception:
+                pass
         if conn.registered:
             try:
                 self._sel.unregister(conn.sock)

@@ -108,6 +108,15 @@ class PooledConn(object):
                         'remote response truncated mid-payload'))
                     break
                 if rid is None:
+                    if header.get('sub') is not None:
+                        # a server-initiated subscription push frame:
+                        # never a pool concern (subscribe_stream uses
+                        # its own dedicated connection) — a stray one
+                        # here means a subscription leaked onto the
+                        # pooled conn; discard it rather than
+                        # misreading it as a v1 downgrade
+                        counter_bump('remote pool push discarded')
+                        continue
                     # a v1 server answered our v2 frame: correct
                     # response, no multiplexing — deliver to the
                     # oldest-sent waiter and downgrade the endpoint

@@ -38,6 +38,8 @@ import os
 import threading
 from collections import OrderedDict
 
+from .. import integrity as mod_integrity
+
 
 def _estimate_nbytes(result):
     """Resident-size estimate of a ScanResult: the serialized length
@@ -60,10 +62,18 @@ def _estimate_nbytes(result):
 def tree_validators(indexroot):
     """Stat identities of every directory a publish renames into
     (plus the `all` shard file).  None entries record absence — a
-    directory appearing later is a change too."""
+    directory appearing later is a change too.
+
+    The integrity catalog rides along because the directory stats
+    alone are blind to one cross-process case: a publish that renames
+    into per-day subdirectories which ALL already exist changes
+    by_day/<day> but not by_day itself.  Every commit rewrites the
+    catalog atomically, so its stat identity is a per-publish change
+    signal at the tree root — one extra os.stat per hit."""
     if not indexroot:
         return []
     paths = [indexroot,
+             mod_integrity.catalog_path(indexroot),
              os.path.join(indexroot, 'all'),
              os.path.join(indexroot, 'by_day'),
              os.path.join(indexroot, 'by_hour'),

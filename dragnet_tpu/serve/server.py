@@ -80,6 +80,7 @@ from . import lifecycle as mod_lifecycle
 from . import protocol as mod_protocol
 from . import qcache as mod_qcache
 from . import residency as mod_residency
+from . import subscribe as mod_subscribe
 
 MAX_REQUEST_BYTES = mod_protocol.MAX_FRAME_BYTES
 
@@ -357,6 +358,11 @@ class DnServer(object):
         # DN_EVENTS — both off by default, costing nothing disabled
         self.history = None
         self.log = mod_log.get('serve')
+        # standing queries (serve/subscribe.py): registered v2
+        # subscribers get delta/full result frames PUSHED on publish
+        # — one incremental merge per publish batch serves all of
+        # them.  DN_SUB_MAX=0 disables (requests answer cleanly).
+        self.subman = mod_subscribe.SubscriptionManager(self)
         self.running = False
         self.draining = False
         self._listener = None
@@ -417,7 +423,9 @@ class DnServer(object):
             on_request=self._on_frame,
             on_overflow=self._on_overflow,
             on_accept=self._on_accept,
+            on_close=self.subman.on_conn_close,
             log=self.log)
+        self.subman.start()
         self.running = True
         _SERVER_LEAKS.track(self)
         self._hook = mod_lifecycle.install_writer_invalidation()
@@ -533,6 +541,9 @@ class DnServer(object):
         leftover = sum(1 for t in workers if t.is_alive())
         if leftover:
             self.log.warn('drain grace expired', abandoned=leftover)
+        # standing queries end cleanly: each subscriber gets an 'end'
+        # frame queued before the loop flushes and closes below
+        self.subman.stop()
         # flush queued response bytes (the draining rejections the
         # workers just framed included), then close every connection
         self.loop.shutdown(max(1.0, deadline - time.monotonic() + 1))
@@ -963,6 +974,10 @@ class DnServer(object):
             # (serve/ioloop.py)
             'protocol': self.loop.stats()
             if self.loop is not None else {},
+            # standing-query subscriptions (serve/subscribe.py):
+            # active/group gauges, push/shed/recompute counters,
+            # per-group and per-subscriber detail
+            'subscriptions': self.subman.stats_doc(),
             'caches': {
                 'shard_handles': mod_iqmt.shard_cache_stats(),
                 'find_memo': mod_iqmt.find_cache_stats(),
@@ -1190,6 +1205,19 @@ class DnServer(object):
                 deadline_ms = self.conf['deadline_ms']
             deadline_at = rx + deadline_ms / 1000.0 \
                 if deadline_ms and deadline_ms > 0 else None
+            if req.get('op') == 'subscribe':
+                # needs the CONNECTION (execute() is transport-
+                # blind): register, answer, THEN queue the seed
+                # frame — the loop's FIFO write queue guarantees the
+                # registration ack reaches the peer first
+                self._bump_op('subscribe')
+                rc, out, err, extra, sub = self.subman.subscribe(
+                    conn, req, proto)
+                self._send_response(conn, proto, rid, rc, out, err,
+                                    extra)
+                if sub is not None:
+                    self.subman.activate(sub)
+                return
             rc, out, err, extra = self.execute(
                 req, tenant=tenant, deadline_at=deadline_at)
             self._send_response(conn, proto, rid, rc, out, err,
@@ -1244,6 +1272,13 @@ class DnServer(object):
         self._bump_op(op)
         if op == 'ping':
             return 0, b'', b'', {}
+        if op == 'sub_ack':
+            # subscription flow control (serve/subscribe.py): tiny,
+            # never queued — a throttled ack path would BE the
+            # backpressure bug it exists to prevent
+            return self.subman.ack(req)
+        if op == 'unsubscribe':
+            return self.subman.unsubscribe(req)
         if op == 'health':
             # the replica-probe op (scatter-gather routers, load
             # balancers): tiny, never queued behind admission.  The
@@ -1823,7 +1858,16 @@ class DnServer(object):
                 raise
             mod_cli.fatal(e)
         flags['coalesced'] = shared
-        if use_cache:
+        if use_cache and not shared:
+            # only the compute LEADER populates the cache: its epoch
+            # and validators predate its own tree read, so a write
+            # racing the execution stamps the entry already-stale.  A
+            # coalesced follower captured them AFTER the leader began
+            # computing — a write landing in between would let the
+            # follower stamp the leader's pre-write result with
+            # post-write validators, freezing a stale entry until the
+            # next in-process epoch bump (forever, for a tree only
+            # cross-process writers touch)
             self.qcache.put(key, cache_epoch, cache_validators,
                             result)
         # coalesced requests demux through private clones: the output

@@ -209,11 +209,82 @@ def fetch_fleet(remote, timeout_s=30.0, events_limit=None):
                       cause=DNError(str(e)))
 
 
-def top_main(remote, interval_ms, once=False, out=None):
+def _top_subscribed(remote, interval_ms, once, out):
+    """The push-path console (`dn top --subscribe`): one standing
+    fleet subscription, frames arriving as the server publishes them
+    — no re-poll, no per-refresh aggregation server-side.  Returns an
+    exit code, or None when the endpoint cannot push (a v1 or
+    pre-push server) and the caller should fall back to polling.  A
+    mid-stream transport cut reconnects with the resume token; the
+    server recognizing the token skips the re-seed."""
+    from . import client as mod_client
+    req = {'op': 'subscribe', 'watch': 'fleet',
+           'interval_ms': max(100, int(interval_ms))}
+    resume = None
+    first = True
+    failures = 0
+    while True:
+        stream = None
+        try:
+            stream = mod_client.subscribe_stream(remote, dict(req),
+                                                 resume=resume)
+            for fr in stream:
+                failures = 0
+                resume = (fr['token'], fr['payload'])
+                doc = json.loads(fr['payload'].decode('utf-8'))
+                if once:
+                    out.write(render_frame(doc, ansi=False))
+                    out.flush()
+                    return 0
+                frame = HOME + render_frame(doc, ansi=True) + \
+                    CLEAR_TO_END
+                if first:
+                    frame = '\x1b[2J' + frame
+                    first = False
+                try:
+                    out.write(frame)
+                    out.flush()
+                except (BrokenPipeError, OSError):
+                    return 0
+            # clean 'end' frame (server draining): reconnect and
+            # keep watching — the replacement coming up is exactly
+            # when the operator is looking
+            time.sleep(interval_ms / 1000.0)
+        except mod_client.SubscribeUnsupported:
+            return None
+        except KeyboardInterrupt:
+            out.write('\n')
+            return 0
+        except (DNError, OSError, ValueError) as e:
+            failures += 1
+            if once or failures > 5:
+                sys.stderr.write('dn: fleet subscription failed: '
+                                 '%s\n' % getattr(e, 'message', e))
+                return 1
+            try:
+                time.sleep(interval_ms / 1000.0)
+            except KeyboardInterrupt:
+                out.write('\n')
+                return 0
+        finally:
+            if stream is not None:
+                stream.close()
+
+
+def top_main(remote, interval_ms, once=False, out=None,
+             subscribe=False):
     """The console loop; returns the exit code.  `once` renders one
-    frame without ANSI control codes and exits."""
+    frame without ANSI control codes and exits.  `subscribe` rides
+    the push path (serve/subscribe.py) and falls back to polling —
+    with a one-line notice — against servers that cannot push."""
     if out is None:
         out = sys.stdout
+    if subscribe:
+        rc = _top_subscribed(remote, interval_ms, once, out)
+        if rc is not None:
+            return rc
+        sys.stderr.write('dn: server does not support subscriptions;'
+                         ' falling back to polling\n')
     first = True
     while True:
         banner = None

@@ -459,3 +459,29 @@ def test_device_config_rejects_bad_values():
         err = mod_config.device_config(env=env)
         assert isinstance(err, DNError), env
         assert str(err).startswith(list(env)[0]), env
+
+
+def test_subscribe_config_defaults():
+    conf = mod_config.subscribe_config(env={})
+    assert conf == {'max': 64, 'coalesce_ms': 250, 'queue_depth': 4,
+                    'delta_pct': 50}
+
+
+def test_subscribe_config_parses_overrides():
+    conf = mod_config.subscribe_config(env={
+        'DN_SUB_MAX': '0', 'DN_SUB_COALESCE_MS': '10',
+        'DN_SUB_QUEUE_DEPTH': '1', 'DN_SUB_DELTA_PCT': '100'})
+    assert conf == {'max': 0, 'coalesce_ms': 10, 'queue_depth': 1,
+                    'delta_pct': 100}
+
+
+def test_subscribe_config_rejects_bad_values():
+    for env in ({'DN_SUB_MAX': 'many'},
+                {'DN_SUB_MAX': '-1'},
+                {'DN_SUB_COALESCE_MS': '5'},
+                {'DN_SUB_COALESCE_MS': '2.5'},
+                {'DN_SUB_QUEUE_DEPTH': '0'},
+                {'DN_SUB_DELTA_PCT': 'half'}):
+        err = mod_config.subscribe_config(env=env)
+        assert isinstance(err, DNError), env
+        assert str(err).startswith(list(env)[0]), env

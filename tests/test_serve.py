@@ -835,6 +835,8 @@ def test_serve_validate_ok(monkeypatch):
                    b'fleet obs ok: history_s=0 events=0 '
                    b'events_file=off top_interval_ms=1000 '
                    b'fleet_timeout_s=5\n'
+                   b'subscribe config ok: max=64 coalesce_ms=250 '
+                   b'queue_depth=4 delta_pct=50\n'
                    b'router config ok: probe_ms=500 failures=3 '
                    b'cooldown_ms=2000 hedge_ms=0 fetch_timeout_s=60 '
                    b'partial=error\n'

@@ -771,3 +771,146 @@ def test_tenant_flood_fault_clean_busy(corpus, tmp_path,
         assert b'server busy' in err
     finally:
         srv.stop()
+
+
+# -- subscription push frames: fuzz + negotiation ---------------------------
+
+def _sub_req(corpus):
+    return {'op': 'subscribe', 'ds': corpus['ds'],
+            'config': corpus['rc_path'], 'interval': 'day',
+            'queryconfig': {'breakdowns': [
+                {'name': 'host', 'field': 'host'}]},
+            'opts': {}}
+
+
+def test_sub_ack_unknown_id_clean_error(server):
+    rc, hd, out, err = mod_client.request_bytes(
+        server.socket_path,
+        {'op': 'sub_ack', 'sub': 'nope', 'seq': 1})
+    assert rc == 1
+    assert b'unknown subscription' in err
+
+
+def test_sub_duplicate_and_bad_acks_idempotent(server, corpus):
+    """Replayed acks are idempotent (the watermark only moves
+    forward), future/garbage seqs are rejected cleanly, and none of
+    it perturbs the stream."""
+    stream = mod_client.subscribe_stream(server.socket_path,
+                                         _sub_req(corpus))
+    try:
+        seed = next(stream)
+        assert seed['kind'] == 'full' and seed['seq'] == 1
+        sid = seed['sub']
+        # ack seq 1 three times (the suspended generator has not
+        # acked yet): first advances, the rest are duplicates
+        for _ in range(3):
+            rc, hd, out, err = mod_client.request_bytes(
+                server.socket_path,
+                {'op': 'sub_ack', 'sub': sid, 'seq': 1})
+            assert rc == 0, err
+        for bad in (99, 0, -1, True, 'x', None):
+            rc, hd, out, err = mod_client.request_bytes(
+                server.socket_path,
+                {'op': 'sub_ack', 'sub': sid, 'seq': bad})
+            assert rc == 1, bad
+            assert b'bad ack seq' in err, bad
+        st = mod_client.stats(server.socket_path)
+        assert st['subscriptions']['counters']['duplicate_acks'] >= 2
+    finally:
+        stream.close()
+
+
+def test_sub_push_torn_fault_detected_and_recoverable(
+        corpus, tmp_path, monkeypatch):
+    """serve.push_torn armed: the seed push is cut mid-frame and the
+    connection closed — the client surfaces a clean transport error
+    (never short bytes), and once disarmed a fresh subscribe on the
+    SAME server succeeds: torn pushes never wedge it."""
+    from dragnet_tpu import faults as mod_faults
+    sock = str(tmp_path / 'torn_push.sock')
+    monkeypatch.setenv('DN_FAULTS', 'serve.push_torn:error:1.0')
+    mod_faults.reset()
+    srv = mod_server.DnServer(socket_path=sock, conf=_conf()).start()
+    try:
+        stream = mod_client.subscribe_stream(sock, _sub_req(corpus))
+        with pytest.raises(DNError):
+            next(stream)
+        stream.close()
+        monkeypatch.delenv('DN_FAULTS')
+        mod_faults.reset()
+        stream = mod_client.subscribe_stream(sock, _sub_req(corpus))
+        seed = next(stream)
+        assert seed['kind'] == 'full' and seed['payload']
+        stream.close()
+        assert mod_client.health(sock)['ok'] is True
+    finally:
+        monkeypatch.delenv('DN_FAULTS', raising=False)
+        mod_faults.reset()
+        srv.stop()
+
+
+def test_v1_peer_cannot_subscribe(server, corpus):
+    """A v1 subscribe (no proto/id): clean error, connection closed
+    — a v1 peer structurally can never receive a push frame."""
+    s = _dial(server.socket_path)
+    try:
+        f = s.makefile('rb')
+        s.sendall(json.dumps(_sub_req(corpus)).encode() + b'\n')
+        header, payload = _read_frame(f)
+        assert header is not None and header['rc'] == 1
+        assert 'id' not in header and 'sub' not in header
+        assert b'protocol 2' in payload
+        assert f.read(1) == b''          # closed: no push can follow
+    finally:
+        s.close()
+    st = mod_client.stats(server.socket_path)
+    assert st['subscriptions']['active'] == 0
+
+
+def test_pool_discards_unsolicited_push_frames(tmp_path):
+    """A (misbehaving) server that interleaves a push frame before
+    the response on a pooled connection: the demux discards it and
+    resolves the request with the RIGHT bytes — push frames never
+    corrupt the pool or get misread as a v1 downgrade."""
+    sock = str(tmp_path / 'pushy.sock')
+    listener = mod_socket.socket(mod_socket.AF_UNIX,
+                                 mod_socket.SOCK_STREAM)
+    listener.bind(sock)
+    listener.listen(8)
+    stop = threading.Event()
+
+    def pushy_server():
+        listener.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except mod_socket.timeout:
+                continue
+            except OSError:
+                return
+            f = conn.makefile('rb')
+            line = f.readline()
+            if line:
+                req = json.loads(line.decode())
+                out = b'pong\n'
+                hdr = {'proto': 2, 'id': req['id'], 'ok': True,
+                       'rc': 0, 'nout': len(out), 'nerr': 0,
+                       'stats': {}, 'retryable': False}
+                conn.sendall(
+                    mod_protocol.encode_push(
+                        'sub-ghost', 1, 0, 'full', b'noise\n') +
+                    json.dumps(hdr).encode() + b'\n' + out)
+            f.close()
+            conn.close()
+
+    t = threading.Thread(target=pushy_server, daemon=True)
+    t.start()
+    try:
+        rc, hd, out, err = mod_client.request_bytes(
+            sock, {'op': 'ping'}, pooled=True)
+        assert rc == 0 and out == b'pong\n'
+        assert not mod_pool.get().is_v1(sock)
+    finally:
+        stop.set()
+        t.join(3)
+        listener.close()

@@ -51,6 +51,17 @@ pending, mid-rewrite, post-kill, post-compaction), failures must be
 clean `dn:` errors, and after a final converge compaction the live
 tree must byte-equal the from-scratch build shard for shard with
 zero stranded tmps.
+
+`--subscribe` runs the standing-query drill instead (`make
+soak-subscribe`): a `dn subscribe` flood over the 3-member cluster
+(in-process readers on every member plus a real `dn subscribe` CLI
+subprocess) while publishes land under armed push/transport faults
+(torn push frames force token-based resume); a `dn build` publisher
+subprocess and the CLI subscriber are SIGKILLed mid-stream.  At every
+quiescent epoch each subscriber's latest pushed payload must be
+byte-identical to a `dn query --remote` poll, the killed publisher's
+tree must converge with zero torn shards, the killed subscriber must
+be shed without delaying the healthy flood, and nothing may wedge.
 """
 
 import argparse
@@ -857,6 +868,466 @@ def soak_cluster(root, fast=False, verbose=True, floor=None):
         s.no_replica_drill()
     finally:
         s.stop_cluster()
+    return s.summary()
+
+
+# -- standing-query drill (dn subscribe flood) ------------------------------
+
+# faults armed while publishes land and pushes fan out: torn push
+# frames (the subscriber must detect the short frame and resume from
+# its last acked token), failed push writes, and client-side read
+# chaos on the subscriber connections
+SUBSCRIBE_SPEC = ('serve.push_torn:error:0.25:91,'
+                  'serve.write:error:0.08:92,'
+                  'client.recv:error:0.05:93')
+
+
+class _SubReader(threading.Thread):
+    """One standing-query subscriber: a dedicated push connection
+    whose frames are acked by the client loop, resumed with the last
+    frame's token after torn frames or transport faults, and whose
+    latest payload is what the quiescent byte-identity checks
+    compare against a poll."""
+
+    def __init__(self, sock, req, fmt):
+        super(_SubReader, self).__init__(daemon=True)
+        self.sock = sock
+        self.req = req
+        self.fmt = fmt
+        self.lock = threading.Lock()
+        self.latest = None
+        self.frames = 0
+        self.resumes = 0
+        self.stream_errors = 0
+        self.hard_errors = []
+        self.stop_ev = threading.Event()
+
+    def run(self):
+        resume = None
+        failures = 0
+        while not self.stop_ev.is_set():
+            stream = mod_client.subscribe_stream(
+                self.sock, dict(self.req), resume=resume)
+            try:
+                for fr in stream:
+                    with self.lock:
+                        self.latest = fr['payload']
+                        self.frames += 1
+                    resume = (fr['token'], fr['payload'])
+                    failures = 0
+                return          # 'end' frame: the member drained
+            except DNError as e:
+                # a torn push, a faulted write, or read chaos: the
+                # stream dies CLEANLY and the resume token skips the
+                # reseed (RemoteTransportError is a DNError)
+                self.stream_errors += 1
+                failures += 1
+                if failures > 10:
+                    self.hard_errors.append(
+                        'gave up after %d stream failures: %r'
+                        % (failures, e))
+                    return
+                if resume is not None:
+                    self.resumes += 1
+                time.sleep(0.05 * failures)
+            except Exception as e:
+                self.hard_errors.append(repr(e))
+                return
+            finally:
+                try:
+                    stream.close()
+                except Exception:
+                    pass
+
+
+class SubscribeSoak(ClusterSoak):
+    """The standing-query drill: a `dn subscribe` flood over the
+    3-member cluster (members a/c in-process, member b the
+    SIGKILL-able subprocess) while publishes land under armed
+    push/transport faults.  The contract: at every quiescent epoch
+    each subscriber's latest pushed payload is BYTE-IDENTICAL to a
+    `dn query --remote` poll, a SIGKILLed publisher leaves a tree
+    the next build converges (subscribers re-converge, zero torn
+    shards), a SIGKILLed subscriber is shed without delaying the
+    healthy flood, and nothing ever wedges."""
+
+    def __init__(self, ctx, fast=False, verbose=True):
+        super(SubscribeSoak, self).__init__(ctx, verbose=verbose)
+        self.fast = fast
+        self.readers = []
+        self.cli_sub = None
+        self.cli_out = None
+        self.cli_seed = None
+        self.sub_counters = {}
+
+    # -- flood lifecycle ----------------------------------------------
+
+    def sub_req(self, fmt):
+        return {'op': 'subscribe', 'ds': self.ctx['ds'][fmt],
+                'config': self.ctx['rc_path'], 'interval': 'day',
+                'queryconfig': {'breakdowns': [
+                    {'name': 'host', 'field': 'host'}]},
+                'opts': {}}
+
+    def start_flood(self):
+        per = 1 if self.fast else 2
+        for m in 'abc':
+            for fmt in FORMATS:
+                for _ in range(per):
+                    rd = _SubReader(self.socks[m],
+                                    self.sub_req(fmt), fmt)
+                    rd.start()
+                    self.readers.append(rd)
+        deadline = time.time() + 60
+        for rd in self.readers:
+            while time.time() < deadline:
+                with rd.lock:
+                    if rd.latest is not None:
+                        break
+                time.sleep(0.05)
+            else:
+                self.violate('subscribe flood: a reader on %s '
+                             'never received its seed frame'
+                             % rd.sock)
+
+    def start_cli_subscriber(self):
+        """`dn subscribe` as a real subprocess against member b —
+        the JSONL stream the subscriber SIGKILL drill tears down."""
+        fmt = FORMATS[0]
+        self.cli_seed = self.poll(fmt)
+        self.cli_out = open(os.path.join(self.ctx['root'],
+                                         'sub_cli.jsonl'), 'wb')
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        env.pop('DN_FAULTS', None)
+        self.cli_sub = subprocess.Popen(
+            [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+             'subscribe', '--remote', self.socks['b'],
+             '-b', 'host', self.ctx['ds'][fmt]],
+            env=env, stdout=self.cli_out,
+            stderr=subprocess.DEVNULL)
+        # wait for the seed line so the registration happens at THIS
+        # quiescent epoch — the seed-vs-poll identity check depends
+        # on no publish racing the subprocess startup
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if os.path.getsize(self.cli_out.name) > 0:
+                return
+            time.sleep(0.1)
+        self.violate('subscribe: CLI subscriber never emitted its '
+                     'seed frame')
+
+    def stop_flood(self):
+        for rd in self.readers:
+            rd.stop_ev.set()
+        if self.cli_sub is not None and self.cli_sub.poll() is None:
+            self.cli_sub.kill()
+            self.cli_sub.wait()
+        if self.cli_out is not None:
+            self.cli_out.close()
+        # stopping the members drains every group: subscribers get a
+        # final 'end' frame, so every reader generator exhausts —
+        # a reader still alive after that is a wedge
+        self.stop_cluster()
+        for rd in self.readers:
+            rd.join(30)
+            if rd.is_alive():
+                self.violate('subscribe: reader on %s wedged '
+                             '(never exited after the drain)'
+                             % rd.sock)
+
+    # -- publishes + identity -----------------------------------------
+
+    def publish_round(self, n, spec=None):
+        """Append + rebuild both formats while `spec` is armed, then
+        hold the faults through the coalesce window so the push
+        fan-out itself runs under chaos."""
+        prior = os.environ.get('DN_FAULTS')
+        if spec:
+            os.environ['DN_FAULTS'] = spec
+        try:
+            start = self.ctx['n']
+            gen_data(self.ctx['datafile'], n, start=start,
+                     days=self.ctx['days'])
+            self.ctx['n'] += n
+            for fmt in FORMATS:
+                rc, out, err = run_cli(
+                    ['build', self.ctx['ds'][fmt]],
+                    env={'DN_INDEX_FORMAT': fmt})
+                if rc != 0:
+                    self.violate('subscribe: publish build (%s) '
+                                 'failed: %r' % (fmt, err[-200:]))
+            if spec:
+                time.sleep(0.6)     # pushes land while armed
+        finally:
+            if prior is None:
+                os.environ.pop('DN_FAULTS', None)
+            else:
+                os.environ['DN_FAULTS'] = prior
+
+    def _try_poll(self, fmt):
+        rc, out, err = run_cli(['query', '--remote',
+                                self.socks['a'], '-b', 'host',
+                                self.ctx['ds'][fmt]])
+        return out if rc == 0 else None
+
+    def poll(self, fmt):
+        err = b''
+        for _ in range(3):
+            out = self._try_poll(fmt)
+            if out is not None:
+                return out
+            time.sleep(0.2)
+        self.violate('subscribe: identity poll (%s) failed' % fmt)
+        return None
+
+    def settle_identity(self, label, timeout_s=45.0):
+        """The pinned contract at a quiescent epoch: every
+        subscriber's latest pushed payload and a poll converge to
+        EXACTLY the same bytes — never a hang, never divergent
+        bytes.  The poll is re-taken while waiting: a poll fired
+        inside the post-publish window can coalesce onto a compute
+        that began mid-publish and legitimately carry bytes one
+        frame behind the committed tree."""
+        deadline = time.time() + timeout_s
+        pending = list(self.readers)
+        golden = {}
+        while True:
+            for fmt in FORMATS:
+                got = self._try_poll(fmt)
+                if got is not None:
+                    golden[fmt] = got
+            pending = [
+                rd for rd in pending
+                if golden.get(rd.fmt) is None or
+                rd.latest != golden[rd.fmt]]
+            if not pending or time.time() >= deadline:
+                break
+            time.sleep(0.25)
+        self.ops += len(self.readers)
+        for fmt in FORMATS:
+            if golden.get(fmt) is None:
+                self.violate('subscribe [%s]: identity poll (%s) '
+                             'kept failing' % (label, fmt))
+        for rd in pending:
+            if golden.get(rd.fmt) is None:
+                continue
+            if rd.hard_errors:
+                self.violate('subscribe [%s]: reader on %s died: %s'
+                             % (label, rd.sock, rd.hard_errors[-1]))
+            else:
+                with rd.lock:
+                    latest = rd.latest
+                    frames = rd.frames
+                self.violate('subscribe [%s]: pushed payload '
+                             '(%s via %s) never converged to the '
+                             'polled bytes (alive=%r frames=%d '
+                             'got=%r want=%r)'
+                             % (label, rd.fmt, rd.sock,
+                                rd.is_alive(), frames,
+                                (latest or b'')[:200],
+                                golden[rd.fmt][:200]))
+
+    # -- drills -------------------------------------------------------
+
+    def kill_publisher_drill(self):
+        """SIGKILL a `dn build` subprocess mid-publish: the next
+        clean build must converge the tree (recovery sweep, zero
+        torn shards) and every subscriber must re-converge to the
+        committed bytes."""
+        fmt = FORMATS[0]
+        start = self.ctx['n']
+        gen_data(self.ctx['datafile'], 400, start=start,
+                 days=self.ctx['days'])
+        self.ctx['n'] += 400
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   DN_INDEX_FORMAT=fmt)
+        env.pop('DN_FAULTS', None)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO_ROOT, 'bin', 'dn.py'),
+             'build', self.ctx['ds'][fmt]],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        time.sleep(0.4)         # let shard flushes get in flight
+        proc.kill()
+        proc.wait()
+        self.note('SIGKILLed publisher mid-build')
+        for f2 in FORMATS:
+            build(self.ctx, f2)
+        for f2 in FORMATS:
+            litter = tree_tmp_litter(self.ctx['idx'][f2])
+            if litter:
+                self.violate('subscribe publisher kill: torn '
+                             'shards (%s): %s' % (f2, litter))
+        self.settle_identity('post-publisher-kill')
+
+    def kill_subscriber_drill(self):
+        """SIGKILL the CLI subscriber mid-stream: member b must shed
+        the dead subscription, its JSONL prefix must be well-formed
+        with a seq-1 seed frame byte-identical to the registration
+        poll, and the healthy flood must keep converging."""
+        before = self.active_subs(self.socks['b'])
+        self.cli_sub.kill()
+        self.cli_sub.wait()
+        self.note('SIGKILLed CLI subscriber mid-stream')
+        self.check_cli_stream()
+        deadline = time.time() + 20
+        after = before
+        while time.time() < deadline:
+            after = self.active_subs(self.socks['b'])
+            if before is not None and after is not None and \
+                    after < before:
+                break
+            time.sleep(0.2)
+        self.ops += 1
+        if not (before is not None and after is not None and
+                after < before):
+            self.violate('subscribe: member b never shed the '
+                         'SIGKILLed subscriber (active %r -> %r)'
+                         % (before, after))
+        self.publish_round(60)
+        self.settle_identity('post-subscriber-kill')
+
+    def check_cli_stream(self):
+        self.cli_out.flush()
+        self.ops += 1
+        with open(self.cli_out.name, 'rb') as f:
+            lines = f.read().splitlines()
+        if not lines:
+            self.violate('subscribe: CLI subscriber emitted no '
+                         'frames before the kill')
+            return
+        try:
+            docs = [json.loads(ln.decode('utf-8')) for ln in lines]
+        except ValueError:
+            self.violate('subscribe: malformed CLI subscriber '
+                         'JSONL: %r' % lines[-1][-200:])
+            return
+        if docs[0].get('seq') != 1 or docs[0].get('kind') != 'full':
+            self.violate('subscribe: CLI stream did not start with '
+                         'the seq-1 seed frame: %r'
+                         % {k: docs[0].get(k)
+                            for k in ('seq', 'kind')})
+        elif self.cli_seed is not None and \
+                docs[0].get('payload') != \
+                self.cli_seed.decode('utf-8'):
+            self.violate('subscribe: CLI seed frame diverges from '
+                         'the polled bytes')
+
+    # -- observability ------------------------------------------------
+
+    def active_subs(self, sock):
+        try:
+            doc = mod_client.stats(sock, timeout_s=30.0)
+        except Exception:
+            return None
+        return (doc.get('subscriptions') or {}).get('active')
+
+    def fleet_obs_check(self):
+        """`dn stats --cluster` must carry the merged subscriber
+        count (honest absence would mean a member lost its
+        manager)."""
+        self.ops += 1
+        rc, out, err = run_cli(['stats', '--cluster', '--remote',
+                                self.socks['a']])
+        if rc != 0:
+            self.violate('subscribe: dn stats --cluster failed: %r'
+                         % err[-200:])
+            return
+        try:
+            doc = json.loads(out.decode('utf-8'))
+        except ValueError:
+            self.violate('subscribe: malformed fleet doc')
+            return
+        agg = (doc.get('aggregate') or {}).get('subscriptions')
+        if agg is None or agg < len(self.readers):
+            self.violate('subscribe: fleet doc merges %r active '
+                         'subscriptions; flood holds %d'
+                         % (agg, len(self.readers)))
+
+    def collect_counters(self):
+        agg = {}
+        for sock in self.socks.values():
+            try:
+                doc = mod_client.stats(sock, timeout_s=10.0)
+            except Exception:
+                continue
+            counters = ((doc.get('subscriptions') or {})
+                        .get('counters')) or {}
+            for k, v in counters.items():
+                agg[k] = agg.get(k, 0) + (v or 0)
+        self.sub_counters = agg
+        if agg.get('pushes', 0) < len(self.readers):
+            self.violate('subscribe: push counters never moved: %r'
+                         % agg)
+
+    def summary(self):
+        doc = super(SubscribeSoak, self).summary()
+        doc['subscribe'] = {
+            'counters': self.sub_counters,
+            'readers': len(self.readers),
+            'frames': sum(r.frames for r in self.readers),
+            'stream_errors': sum(r.stream_errors
+                                 for r in self.readers),
+            'resumes': sum(r.resumes for r in self.readers),
+        }
+        return doc
+
+
+def soak_subscribe(root, fast=False, verbose=True, floor=None):
+    """The standing-query drill under `root`; returns the summary."""
+    mod_faults.reset()
+    ctx = make_corpus(root, n=400 if fast else 1200,
+                      days=5 if fast else 10)
+    for fmt in FORMATS:
+        build(ctx, fmt)
+    # fast sweep cadence so publishes push inside the drill's
+    # timeouts; the subprocess member and CLI subscriber inherit the
+    # knobs from the environment
+    os.environ.update({
+        'DN_SUB_COALESCE_MS': '50', 'DN_SUB_MAX': '64',
+        'DN_SUB_QUEUE_DEPTH': '8',
+        'DN_ROUTER_PROBE_MS': '200', 'DN_ROUTER_FAILURES': '2',
+        'DN_ROUTER_COOLDOWN_MS': '500',
+        'DN_ROUTER_FETCH_TIMEOUT_S': '30',
+        'DN_SERVE_FLEET_TIMEOUT_S': '5'})
+    s = SubscribeSoak(ctx, fast=fast, verbose=verbose)
+    s.start_cluster()
+    try:
+        s.note('subscriber flood (%d in-process readers + 1 CLI '
+               'subscriber)' % (6 if fast else 12))
+        s.start_flood()
+        s.settle_identity('seed')
+        s.start_cli_subscriber()
+        s.note('fault-free publish round')
+        s.publish_round(120)
+        s.settle_identity('fault-free publish')
+        rounds = 3 if fast else 8
+        s.note('armed publish rounds (%d) [%s]'
+               % (rounds, SUBSCRIBE_SPEC))
+        for _ in range(rounds):
+            s.publish_round(80, spec=SUBSCRIBE_SPEC)
+        s.settle_identity('armed publishes')
+        if floor:
+            extra = 0
+            while extra < 60:
+                total = mod_vpipe.global_counters().get(
+                    'faults injected', 0)
+                if total >= floor:
+                    break
+                extra += 1
+                s.note('top-up round %d (%d/%d faults)'
+                       % (extra, total, floor))
+                s.publish_round(40, spec=SUBSCRIBE_SPEC)
+            s.settle_identity('top-up')
+        s.note('fleet observability check')
+        s.fleet_obs_check()
+        s.note('SIGKILL publisher drill')
+        s.kill_publisher_drill()
+        s.note('SIGKILL subscriber drill')
+        s.kill_subscriber_drill()
+        s.collect_counters()
+    finally:
+        s.stop_flood()
     return s.summary()
 
 
@@ -3057,6 +3528,14 @@ def main(argv=None):
                         'assert zero silently wrong bytes and every '
                         'corruption repaired from a co-replica) '
                         'instead of the single-process soak')
+    p.add_argument('--subscribe', action='store_true',
+                   help='run the standing-query drill (a `dn '
+                        'subscribe` flood over the 3-member cluster '
+                        'under armed push/transport faults, a '
+                        'publisher and a subscriber SIGKILLed '
+                        'mid-stream, pushed-vs-polled byte identity '
+                        'at every quiescent epoch) instead of the '
+                        'single-process soak')
     p.add_argument('--min-faults', type=int, default=None,
                    help='required injected-fault floor '
                         '(default: 500, or 50 with --fast; the '
@@ -3076,6 +3555,8 @@ def main(argv=None):
         default_floor = 4 if args.fast else 10
     elif args.resources:
         default_floor = 10 if args.fast else 20
+    elif args.subscribe:
+        default_floor = 4 if args.fast else 12
     else:
         default_floor = 50 if args.fast else 500
     floor = args.min_faults if args.min_faults is not None \
@@ -3089,6 +3570,7 @@ def main(argv=None):
         else soak_overload if args.overload \
         else soak_rebalance if args.rebalance \
         else soak_scrub if args.scrub \
+        else soak_subscribe if args.subscribe \
         else soak_resources if args.resources else soak
     with tempfile.TemporaryDirectory(prefix='dn_soak_') as root:
         summary = runner(root, fast=args.fast, floor=floor)
